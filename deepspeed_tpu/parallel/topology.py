@@ -1,0 +1,246 @@
+"""Named-axis process/device topology and mesh construction.
+
+Parity with reference ``runtime/pipe/topology.py``:
+- ``ProcessTopology`` (topology.py:12-232): named-axis cartesian rank↔coord
+  mapping, axis comm lists, coordinate filtering.
+- ``PipeDataParallelTopology`` (topology.py:235), ``PipeModelDataParallelTopology``
+  (topology.py:246-250): canonical 2-/3-axis layouts.
+- ``PipelineParallelGrid`` (topology.py:252-455): the "mpu" contract —
+  ``get_{data,model,pipe}_parallel_{rank,world_size,group}``.
+
+TPU-native delta: a topology also materializes as a ``jax.sharding.Mesh``
+(``build_mesh``) whose axis order puts the fastest-varying (model-parallel)
+axis innermost so its collectives ride the shortest ICI paths; groups are
+mesh axes, not torch process groups.
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+from itertools import product
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class ProcessTopology:
+    """Cartesian product of named axes; axis 0 is outermost (row-major)."""
+
+    def __init__(self, axes: Sequence[str], dims: Sequence[int]):
+        assert len(axes) == len(dims), "axes and dims must align"
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self.mapping: Dict[Any, int] = {}
+        ranges = [range(d) for d in self.dims]
+        for global_rank, coord in enumerate(product(*ranges)):
+            key = dict(zip(self.axes, coord))
+            self.mapping[self.ProcessCoord(**key)] = global_rank
+
+    def get_rank(self, **coord_kwargs) -> int:
+        if len(coord_kwargs) != len(self.axes):
+            raise ValueError(f"get_rank() needs all axes {self.axes}, got {coord_kwargs}")
+        key = self.ProcessCoord(**coord_kwargs)
+        assert key in self.mapping, f"coord {coord_kwargs} not in topology"
+        return self.mapping[key]
+
+    def get_axis_names(self) -> List[str]:
+        return self.axes
+
+    def get_rank_repr(self, rank: int, omit_axes: Sequence[str] = ("data", "pipe"),
+                      inner_sep: str = "_", outer_sep: str = "-") -> str:
+        omit_axes = list(omit_axes)
+        axes = [a for a in self.get_axis_names() if a not in omit_axes]
+        names = []
+        for ax in axes:
+            ax_rank = getattr(self.get_coord(rank=rank), ax)
+            names.append(f"{ax}{inner_sep}{ax_rank:02d}")
+        return outer_sep.join(names)
+
+    def get_dim(self, axis: str) -> int:
+        if axis not in self.axes:
+            return 0
+        return self.dims[self.axes.index(axis)]
+
+    def get_coord(self, rank: int):
+        for coord, idx in self.mapping.items():
+            if idx == rank:
+                return coord
+        raise ValueError(f"rank {rank} not in topology")
+
+    def get_axis_comm_lists(self, axis: str) -> List[List[int]]:
+        """Groups of ranks that vary along `axis` with all others fixed."""
+        if axis not in self.axes:
+            return []
+        other_axes = [a for a in self.axes if a != axis]
+        lists: List[List[int]] = []
+        ranges = [range(self.get_dim(a)) for a in other_axes]
+        for coord in product(*ranges):
+            other = dict(zip(other_axes, coord))
+            sub = [self.get_rank(**{axis: i}, **other) for i in range(self.get_dim(axis))]
+            lists.append(sub)
+        return lists
+
+    def filter_match(self, **filter_kwargs) -> List[int]:
+        """Ranks whose coordinates match all given axis=value filters."""
+        def _match(coord):
+            return all(getattr(coord, k) == v for k, v in filter_kwargs.items())
+        return sorted(rank for coord, rank in self.mapping.items() if _match(coord))
+
+    def get_axis_list(self, axis: str, idx: int) -> List[int]:
+        axis_num = self.axes.index(axis)
+        return sorted(rank for coord, rank in self.mapping.items() if coord[axis_num] == idx)
+
+    def world_size(self) -> int:
+        return int(np.prod(self.dims)) if self.dims else 1
+
+    def __str__(self) -> str:
+        return str(self.mapping)
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """(pipe, data) — adjacent pipe stages map to adjacent device coords."""
+
+    def __init__(self, num_pp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """3D parallelism (pipe, data, model); model innermost so tensor-parallel
+    collectives stay on the tightest ICI neighborhood."""
+
+    def __init__(self, num_pp: int, num_mp: int, num_dp: int):
+        super().__init__(axes=["pipe", "data", "model"], dims=[num_pp, num_dp, num_mp])
+
+
+# --------------------------------------------------------------------- #
+# Mesh construction
+# --------------------------------------------------------------------- #
+# Canonical mesh axis names used across the framework.
+DP_AXIS = "data"
+MP_AXIS = "model"
+PP_AXIS = "pipe"
+SP_AXIS = "seq"
+
+
+def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
+               devices=None, axis_order: Tuple[str, ...] = (PP_AXIS, DP_AXIS, SP_AXIS, MP_AXIS)):
+    """Build a ``jax.sharding.Mesh`` with named axes over available devices.
+
+    dp=None infers the remainder of the device count. Axis order places mp
+    innermost (fastest-varying) for the shortest ICI hops, pp outermost; this
+    mirrors PipeModelDataParallelTopology's (pipe, data, model) rank order.
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if dp is None:
+        denom = mp * pp * sp
+        assert n % denom == 0, f"{n} devices not divisible by mp*pp*sp={denom}"
+        dp = n // denom
+    sizes = {PP_AXIS: pp, DP_AXIS: dp, SP_AXIS: sp, MP_AXIS: mp}
+    total = int(np.prod(list(sizes.values())))
+    assert total == n, f"mesh {sizes} needs {total} devices, have {n}"
+    shape = tuple(sizes[a] for a in axis_order)
+    dev_array = np.array(devices).reshape(shape)
+    return Mesh(dev_array, axis_order)
+
+
+class PipelineParallelGrid:
+    """The "mpu" contract over a ProcessTopology (topology.py:252-455).
+
+    Exposes rank/world-size accessors per axis. On TPU, "groups" are the
+    named mesh axes themselves: ``get_*_parallel_group`` returns the axis
+    name for use with shard_map collectives.
+    """
+
+    def __init__(self, topology: Optional[ProcessTopology] = None,
+                 process_ranks: Optional[Sequence[int]] = None,
+                 global_rank: int = 0):
+        if topology is None:
+            topology = PipeDataParallelTopology(1, 1)
+        self._topo = topology
+        self.global_rank = global_rank
+        self.world_size = topology.world_size()
+        coord = topology.get_coord(global_rank)
+        self.data_parallel_size = max(1, topology.get_dim("data"))
+        self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
+        self.model_parallel_size = max(1, topology.get_dim("model"))
+        self.slice_parallel_size = self.model_parallel_size
+        self.data_parallel_id = getattr(coord, "data", 0) if "data" in topology.axes else 0
+        self.stage_id = getattr(coord, "pipe", 0) if "pipe" in topology.axes else 0
+        self.model_parallel_id = getattr(coord, "model", 0) if "model" in topology.axes else 0
+
+        # Rank lists per axis (for checkpoint naming & debugging).
+        self.dp_groups = topology.get_axis_comm_lists("data") if "data" in topology.axes else []
+        self.pp_groups = topology.get_axis_comm_lists("pipe") if "pipe" in topology.axes else []
+        self.mp_groups = topology.get_axis_comm_lists("model") if "model" in topology.axes else []
+
+        # Pipeline adjacency (p2p.py:22-28 parity).
+        self.stage_to_global = {}
+        if "pipe" in topology.axes:
+            kwargs = {a: getattr(coord, a) for a in topology.axes if a != "pipe"}
+            for s in range(self.pipe_parallel_size):
+                self.stage_to_global[s] = topology.get_rank(pipe=s, **kwargs)
+
+    # --- topology ---
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_global_rank(self) -> int:
+        return self.global_rank
+
+    # --- data parallel ---
+    def get_data_parallel_rank(self) -> int:
+        return self.data_parallel_id
+
+    def get_data_parallel_world_size(self) -> int:
+        return self.data_parallel_size
+
+    def get_data_parallel_group(self) -> str:
+        return DP_AXIS
+
+    # --- model parallel ---
+    def get_model_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_model_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def get_model_parallel_group(self) -> str:
+        return MP_AXIS
+
+    # --- slice parallel (reference alias for model parallel, topology.py:445-455) ---
+    def get_slice_parallel_rank(self) -> int:
+        return self.model_parallel_id
+
+    def get_slice_parallel_world_size(self) -> int:
+        return self.model_parallel_size
+
+    def get_slice_parallel_group(self) -> str:
+        return MP_AXIS
+
+    # --- pipeline ---
+    def get_stage_id(self) -> int:
+        return self.stage_id
+
+    def get_pipe_parallel_rank(self) -> int:
+        return self.stage_id
+
+    def get_pipe_parallel_world_size(self) -> int:
+        return self.pipe_parallel_size
+
+    def get_pipe_parallel_group(self) -> str:
+        return PP_AXIS
+
+    def is_first_stage(self) -> bool:
+        return self.stage_id == 0
+
+    def is_last_stage(self) -> bool:
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global_rank(self, stage_id: int) -> int:
+        return self.stage_to_global[stage_id]

@@ -1,0 +1,324 @@
+"""The DeepSpeed-style JSON config system, TPU edition.
+
+Parity with reference ``runtime/config.py`` (DeepSpeedConfig, config.py:515):
+- accepts a path to a JSON file or an already-parsed dict
+- rejects duplicate JSON keys (config_utils)
+- elasticity pre-pass rewrites the batch keys before the solver runs
+  (config.py:537-588)
+- batch triple inference: train_batch_size =
+  micro_batch_per_device * gradient_accumulation_steps * dp_world_size, with
+  any one/two of the three inferable from the others (config.py:655-725)
+- ~50 typed getters with defaults (config.py:48-491)
+- error checks for missing/conflicting batch info (config.py:746-782)
+
+TPU deltas: ``bf16`` section is first-class; ``world_size`` is the number of
+*data-parallel replicas* (mesh dp-axis size), not processes, since one JAX
+process drives many chips.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from . import config_utils
+from .. import constants as C
+from .zero.config import ZeroConfig
+from .activation_checkpointing.config import ActivationCheckpointingConfig
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+class FlopsProfilerConfig:
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.FLOPS_PROFILER, {})
+        get = config_utils.get_scalar_param
+        self.enabled = get(d, C.FLOPS_PROFILER_ENABLED, C.FLOPS_PROFILER_ENABLED_DEFAULT)
+        self.profile_step = get(d, C.FLOPS_PROFILER_PROFILE_STEP,
+                                C.FLOPS_PROFILER_PROFILE_STEP_DEFAULT)
+        self.module_depth = get(d, C.FLOPS_PROFILER_MODULE_DEPTH,
+                                C.FLOPS_PROFILER_MODULE_DEPTH_DEFAULT)
+        self.top_modules = get(d, C.FLOPS_PROFILER_TOP_MODULES,
+                               C.FLOPS_PROFILER_TOP_MODULES_DEFAULT)
+        self.detailed = get(d, C.FLOPS_PROFILER_DETAILED, C.FLOPS_PROFILER_DETAILED_DEFAULT)
+
+
+class ProgressiveLayerDropConfig:
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.PROGRESSIVE_LAYER_DROP, {})
+        get = config_utils.get_scalar_param
+        self.enabled = get(d, C.PLD_ENABLED, C.PLD_ENABLED_DEFAULT)
+        self.theta = get(d, C.PLD_THETA, C.PLD_THETA_DEFAULT)
+        self.gamma = get(d, C.PLD_GAMMA, C.PLD_GAMMA_DEFAULT)
+
+
+class PipelineConfig:
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.PIPELINE, {})
+        get = config_utils.get_scalar_param
+        self.stages = get(d, C.PIPELINE_STAGES, C.PIPELINE_STAGES_DEFAULT)
+        self.partition = get(d, C.PIPELINE_PARTITION, C.PIPELINE_PARTITION_DEFAULT)
+        self.seed_layers = get(d, C.PIPELINE_SEED_LAYERS, C.PIPELINE_SEED_LAYERS_DEFAULT)
+        self.activation_checkpoint_interval = get(
+            d, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
+            C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+
+
+class TensorboardConfig:
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.TENSORBOARD, {})
+        get = config_utils.get_scalar_param
+        self.enabled = get(d, C.TENSORBOARD_ENABLED, C.TENSORBOARD_ENABLED_DEFAULT)
+        self.output_path = get(d, C.TENSORBOARD_OUTPUT_PATH, C.TENSORBOARD_OUTPUT_PATH_DEFAULT)
+        self.job_name = get(d, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
+
+
+class MeshConfig:
+    """TPU-native extension: requested logical mesh axis sizes.
+
+    Sizes of -1 / None are inferred (dp absorbs the remainder of the device
+    count after mp/pp/sp are fixed).
+    """
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.MESH, {})
+        get = config_utils.get_scalar_param
+        self.data_parallel_size = get(d, C.MESH_DATA_PARALLEL_SIZE, None)
+        self.model_parallel_size = get(d, C.MESH_MODEL_PARALLEL_SIZE, 1)
+        self.pipe_parallel_size = get(d, C.MESH_PIPE_PARALLEL_SIZE, 1)
+        self.sequence_parallel_size = get(d, C.MESH_SEQUENCE_PARALLEL_SIZE, 1)
+
+
+class DeepSpeedConfig:
+    def __init__(self, config: Union[str, Dict[str, Any]], mpu=None,
+                 param_dict: Optional[Dict[str, Any]] = None,
+                 world_size: Optional[int] = None):
+        if param_dict is not None:
+            self._param_dict = param_dict
+        elif isinstance(config, dict):
+            self._param_dict = config
+        else:
+            self._param_dict = config_utils.load_config_json(config)
+
+        # Data-parallel world size for the batch solver: the mesh dp-axis
+        # size. Resolution order mirrors the reference's mpu override
+        # (config.py:523-535).
+        if world_size is not None:
+            self.world_size = world_size
+        elif mpu is not None:
+            self.world_size = mpu.get_data_parallel_world_size()
+        else:
+            self.world_size = self._infer_default_world_size()
+
+        # Elasticity pre-pass (reference config.py:537-588).
+        self.elasticity_enabled = False
+        self._configure_elasticity()
+
+        self._initialize_params(self._param_dict)
+        self._configure_train_batch_size()
+        self._do_sanity_check()
+
+    # ------------------------------------------------------------------ #
+    def _infer_default_world_size(self) -> int:
+        import os
+        if "WORLD_SIZE" in os.environ:
+            return int(os.environ["WORLD_SIZE"])
+        try:
+            import jax
+            mesh = self._param_dict.get(C.MESH, {})
+            mp = mesh.get(C.MESH_MODEL_PARALLEL_SIZE, 1) or 1
+            pp = mesh.get(C.MESH_PIPE_PARALLEL_SIZE, 1) or 1
+            sp = mesh.get(C.MESH_SEQUENCE_PARALLEL_SIZE, 1) or 1
+            return max(1, jax.device_count() // (mp * pp * sp))
+        except Exception:
+            return 1
+
+    def _configure_elasticity(self) -> None:
+        from ..elasticity import elasticity_enabled, compute_elastic_config
+        if not elasticity_enabled(self._param_dict):
+            return
+        from ..elasticity.config import ElasticityConfigError
+        elastic_dict = self._param_dict[C.ELASTICITY]
+        ignore_non_elastic = elastic_dict.get(
+            C.IGNORE_NON_ELASTIC_BATCH_INFO, C.IGNORE_NON_ELASTIC_BATCH_INFO_DEFAULT)
+        if not ignore_non_elastic:
+            batch_params = (C.TRAIN_BATCH_SIZE, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU,
+                            C.GRADIENT_ACCUMULATION_STEPS)
+            if any(self._param_dict.get(k) is not None for k in batch_params):
+                raise ElasticityConfigError(
+                    "One or more batch related parameters were found in your ds_config "
+                    f"({', '.join(batch_params)}). These parameters *will not be used* since "
+                    "elastic training is enabled, which takes control of these parameters. "
+                    f"If you want to supress this error set '{C.IGNORE_NON_ELASTIC_BATCH_INFO}':true "
+                    "in your elasticity config.")
+        final_batch_size, valid_gpus, micro_batch_size = compute_elastic_config(
+            ds_config=self._param_dict, target_deepspeed_version="0.1.0",
+            world_size=self.world_size)
+        self.elastic_train_batch_size = final_batch_size
+        self.elastic_valid_gpus = valid_gpus
+        self.elasticity_enabled = True
+        self._param_dict[C.TRAIN_BATCH_SIZE] = final_batch_size
+        self._param_dict[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = micro_batch_size
+        self._param_dict[C.GRADIENT_ACCUMULATION_STEPS] = None
+
+    # ------------------------------------------------------------------ #
+    def _initialize_params(self, d: Dict[str, Any]) -> None:
+        get = config_utils.get_scalar_param
+
+        self.train_batch_size = get(d, C.TRAIN_BATCH_SIZE, C.TRAIN_BATCH_SIZE_DEFAULT)
+        self.train_micro_batch_size_per_gpu = get(
+            d, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, C.TRAIN_MICRO_BATCH_SIZE_PER_GPU_DEFAULT)
+        self.gradient_accumulation_steps = get(
+            d, C.GRADIENT_ACCUMULATION_STEPS, C.GRADIENT_ACCUMULATION_STEPS_DEFAULT)
+        self.steps_per_print = get(d, C.STEPS_PER_PRINT, C.STEPS_PER_PRINT_DEFAULT)
+        self.dump_state = get(d, C.DUMP_STATE, C.DUMP_STATE_DEFAULT)
+        self.disable_allgather = get(d, C.DISABLE_ALLGATHER, C.DISABLE_ALLGATHER_DEFAULT)
+
+        self.prescale_gradients = get(d, C.PRESCALE_GRADIENTS, C.PRESCALE_GRADIENTS_DEFAULT)
+        self.gradient_predivide_factor = get(
+            d, C.GRADIENT_PREDIVIDE_FACTOR, C.GRADIENT_PREDIVIDE_FACTOR_DEFAULT)
+        self.sparse_gradients_enabled = get(d, C.SPARSE_GRADIENTS, C.SPARSE_GRADIENTS_DEFAULT)
+        self.allreduce_always_fp32 = get(d, C.ALLREDUCE_ALWAYS_FP32,
+                                         C.ALLREDUCE_ALWAYS_FP32_DEFAULT)
+
+        self.zero_config = ZeroConfig(d)
+        self.zero_optimization_stage = self.zero_config.stage
+        self.zero_enabled = self.zero_optimization_stage > 0
+
+        self.activation_checkpointing_config = ActivationCheckpointingConfig(d)
+        self.flops_profiler_config = FlopsProfilerConfig(d)
+        self.pld_config = ProgressiveLayerDropConfig(d)
+        self.pipeline_config = PipelineConfig(d)
+        self.tensorboard_config = TensorboardConfig(d)
+        self.mesh_config = MeshConfig(d)
+
+        fp16 = d.get(C.FP16, {})
+        self.fp16_enabled = get(fp16, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)
+        self.fp16_loss_scale = get(fp16, C.FP16_LOSS_SCALE, C.FP16_LOSS_SCALE_DEFAULT)
+        self.fp16_initial_scale_power = get(fp16, C.FP16_INITIAL_SCALE_POWER,
+                                            C.FP16_INITIAL_SCALE_POWER_DEFAULT)
+        self.fp16_loss_scale_window = get(fp16, C.FP16_LOSS_SCALE_WINDOW,
+                                          C.FP16_LOSS_SCALE_WINDOW_DEFAULT)
+        self.fp16_hysteresis = get(fp16, C.FP16_HYSTERESIS, C.FP16_HYSTERESIS_DEFAULT)
+        self.fp16_min_loss_scale = get(fp16, C.FP16_MIN_LOSS_SCALE,
+                                       C.FP16_MIN_LOSS_SCALE_DEFAULT)
+
+        bf16 = d.get(C.BF16, {})
+        self.bf16_enabled = get(bf16, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)
+
+        amp = d.get(C.AMP, {})
+        self.amp_enabled = get(amp, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
+        self.amp_params = {k: v for k, v in amp.items() if k != C.AMP_ENABLED}
+
+        self.gradient_clipping = get(d, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
+
+        optimizer = d.get(C.OPTIMIZER)
+        if optimizer is not None:
+            self.optimizer_name = optimizer.get(C.TYPE, C.OPTIMIZER_TYPE_DEFAULT)
+            if self.optimizer_name is not None:
+                self.optimizer_name = self.optimizer_name.lower()
+            self.optimizer_params = optimizer.get(C.OPTIMIZER_PARAMS, {})
+            self.optimizer_legacy_fusion = optimizer.get(C.LEGACY_FUSION,
+                                                         C.LEGACY_FUSION_DEFAULT)
+        else:
+            self.optimizer_name = None
+            self.optimizer_params = {}
+            self.optimizer_legacy_fusion = False
+
+        scheduler = d.get(C.SCHEDULER)
+        if scheduler is not None:
+            self.scheduler_name = scheduler.get(C.TYPE, C.SCHEDULER_TYPE_DEFAULT)
+            self.scheduler_params = scheduler.get(C.SCHEDULER_PARAMS, {})
+        else:
+            self.scheduler_name = None
+            self.scheduler_params = {}
+
+        self.wall_clock_breakdown = get(d, C.WALL_CLOCK_BREAKDOWN,
+                                        C.WALL_CLOCK_BREAKDOWN_DEFAULT)
+        self.memory_breakdown = get(d, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
+
+        self.sparse_attention = d.get(C.SPARSE_ATTENTION)
+
+        ckpt = d.get(C.CHECKPOINT, {})
+        self.checkpoint_tag_validation_mode = get(
+            ckpt, C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
+        if isinstance(self.checkpoint_tag_validation_mode, str):
+            self.checkpoint_tag_validation_mode = self.checkpoint_tag_validation_mode.capitalize()
+        self.checkpoint_tag_validation_enabled = \
+            self.checkpoint_tag_validation_mode != "Ignore"
+        self.checkpoint_tag_validation_fail = self.checkpoint_tag_validation_mode == "Fail"
+
+    # ------------------------------------------------------------------ #
+    def _configure_train_batch_size(self) -> None:
+        """Solve train_batch = micro_batch * grad_accum * world (config.py:655-725)."""
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        world = self.world_size
+
+        if train_batch is not None and micro_batch is not None and grad_acc is not None:
+            pass  # all set; verified in sanity check
+        elif train_batch is not None and micro_batch is not None:
+            grad_acc = train_batch // micro_batch
+            grad_acc //= world
+            self.gradient_accumulation_steps = grad_acc
+        elif train_batch is not None and grad_acc is not None:
+            micro_batch = train_batch // world
+            micro_batch //= grad_acc
+            self.train_micro_batch_size_per_gpu = micro_batch
+        elif micro_batch is not None and grad_acc is not None:
+            self.train_batch_size = micro_batch * grad_acc * world
+        elif train_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_micro_batch_size_per_gpu = train_batch // world
+        elif micro_batch is not None:
+            self.gradient_accumulation_steps = 1
+            self.train_batch_size = micro_batch * world
+        # else: all None → sanity check raises
+
+    def _batch_assertion(self) -> None:
+        train_batch = self.train_batch_size
+        micro_batch = self.train_micro_batch_size_per_gpu
+        grad_acc = self.gradient_accumulation_steps
+        assert train_batch > 0, f"Train batch size: {train_batch} has to be greater than 0"
+        assert micro_batch > 0, f"Micro batch size per device: {micro_batch} has to be greater than 0"
+        assert grad_acc > 0, f"Gradient accumulation steps: {grad_acc} has to be greater than 0"
+        assert train_batch == micro_batch * grad_acc * self.world_size, (
+            f"Check batch related parameters. train_batch_size is not equal to "
+            f"micro_batch_per_gpu * gradient_acc_step * world_size "
+            f"{train_batch} != {micro_batch} * {grad_acc} * {self.world_size}")
+
+    def _do_sanity_check(self) -> None:
+        if self.train_batch_size is None and self.train_micro_batch_size_per_gpu is None:
+            raise DeepSpeedConfigError(
+                f"Either {C.TRAIN_BATCH_SIZE} or {C.TRAIN_MICRO_BATCH_SIZE_PER_GPU} "
+                "must be set in the DeepSpeed config")
+        self._batch_assertion()
+        if self.fp16_enabled and self.bf16_enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.zero_enabled and self.zero_optimization_stage > C.MAX_STAGE_ZERO_OPTIMIZATION:
+            raise DeepSpeedConfigError(
+                f"ZeRO stage {self.zero_optimization_stage} > max "
+                f"{C.MAX_STAGE_ZERO_OPTIMIZATION}")
+        if self.optimizer_name is not None and \
+                self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS:
+            logger.warning(
+                f"Optimizer '{self.optimizer_name}' is not a built-in optimizer; "
+                "it will be resolved against optax at engine construction.")
+
+    # ------------------------------------------------------------------ #
+    @property
+    def precision_dtype(self) -> str:
+        if self.bf16_enabled:
+            return "bfloat16"
+        if self.fp16_enabled:
+            return "float16"
+        return "float32"
+
+    def print(self, name: str = "DeepSpeedConfig") -> None:
+        logger.info(f"{name}:")
+        for k in sorted(self.__dict__):
+            if k.startswith("_"):
+                continue
+            logger.info(f"  {k} = {self.__dict__[k]}")

@@ -1,0 +1,40 @@
+"""Config helpers.
+
+Parity with reference ``runtime/config_utils.py``: scalar/dict param getters
+with defaults and duplicate-key-rejecting JSON loading (config_utils.py:20-33).
+"""
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+
+def get_scalar_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+def get_list_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+def get_dict_param(param_dict: Dict[str, Any], param_name: str, param_default_value: Any) -> Any:
+    return param_dict.get(param_name, param_default_value)
+
+
+def dict_raise_error_on_duplicate_keys(ordered_pairs: List[tuple]) -> Dict[str, Any]:
+    """Reject duplicate keys while parsing JSON (reference config_utils.py:20)."""
+    d = dict(ordered_pairs)
+    if len(d) != len(ordered_pairs):
+        counter: Dict[str, int] = {}
+        for k, _ in ordered_pairs:
+            counter[k] = counter.get(k, 0) + 1
+        keys = [k for k, v in counter.items() if v > 1]
+        raise ValueError(f"Duplicate keys in DeepSpeed config: {keys}")
+    return d
+
+
+def load_config_json(path: str) -> Dict[str, Any]:
+    with open(path, "r") as f:
+        return json.load(f, object_pairs_hook=dict_raise_error_on_duplicate_keys)
+
+
+def loads_config_json(text: str) -> Dict[str, Any]:
+    return json.loads(text, object_pairs_hook=dict_raise_error_on_duplicate_keys)

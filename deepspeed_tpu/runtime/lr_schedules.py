@@ -1,0 +1,299 @@
+"""Learning-rate schedules.
+
+Parity with reference ``runtime/lr_schedules.py``: ``LRRangeTest``
+(lr_schedules.py:301), ``OneCycle`` (:408), ``WarmupLR`` (:677),
+``WarmupDecayLR`` (end of file), selected by name from the ds_config
+``scheduler`` section with identical param keys.
+
+TPU-native design: every schedule is fundamentally a *pure function of the
+global step* (``as_schedule_fn()``), so the engine can close over it inside a
+jitted train step (an ``optax``-style schedule). The stateful class API
+(``step()``, ``get_lr()``, ``state_dict()``) is kept for reference parity and
+host-side logging.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax.numpy as jnp
+
+LR_RANGE_TEST = "LRRangeTest"
+ONE_CYCLE = "OneCycle"
+WARMUP_LR = "WarmupLR"
+WARMUP_DECAY_LR = "WarmupDecayLR"
+VALID_LR_SCHEDULES = [LR_RANGE_TEST, ONE_CYCLE, WARMUP_LR, WARMUP_DECAY_LR]
+
+# ds_config scheduler param keys (names identical to the reference).
+LR_RANGE_TEST_MIN_LR = "lr_range_test_min_lr"
+LR_RANGE_TEST_STEP_RATE = "lr_range_test_step_rate"
+LR_RANGE_TEST_STEP_SIZE = "lr_range_test_step_size"
+LR_RANGE_TEST_STAIRCASE = "lr_range_test_staircase"
+
+CYCLE_FIRST_STEP_SIZE = "cycle_first_step_size"
+CYCLE_FIRST_STAIR_COUNT = "cycle_first_stair_count"
+CYCLE_SECOND_STEP_SIZE = "cycle_second_step_size"
+CYCLE_SECOND_STAIR_COUNT = "cycle_second_stair_count"
+DECAY_STEP_SIZE = "decay_step_size"
+CYCLE_MIN_LR = "cycle_min_lr"
+CYCLE_MAX_LR = "cycle_max_lr"
+DECAY_LR_RATE = "decay_lr_rate"
+CYCLE_MIN_MOM = "cycle_min_mom"
+CYCLE_MAX_MOM = "cycle_max_mom"
+DECAY_MOM_RATE = "decay_mom_rate"
+CYCLE_MOMENTUM = "cycle_momentum"
+
+WARMUP_MIN_LR = "warmup_min_lr"
+WARMUP_MAX_LR = "warmup_max_lr"
+WARMUP_NUM_STEPS = "warmup_num_steps"
+WARMUP_TYPE = "warmup_type"
+WARMUP_LOG_RATE = "log"
+WARMUP_LINEAR_RATE = "linear"
+
+TOTAL_NUM_STEPS = "total_num_steps"
+
+
+class _ScheduleBase:
+    """Stateful wrapper around a pure step→lr function."""
+
+    def __init__(self, optimizer=None, last_batch_iteration: int = -1):
+        self.optimizer = optimizer
+        self.last_batch_iteration = last_batch_iteration
+
+    # -- pure API ------------------------------------------------------- #
+    def lr_at(self, step):
+        raise NotImplementedError
+
+    def as_schedule_fn(self) -> Callable[[Any], Any]:
+        """Return a jit-safe fn(step) → lr for use inside the train step."""
+        return self.lr_at
+
+    # -- stateful parity API ------------------------------------------- #
+    def get_lr(self) -> List[float]:
+        return [float(self.lr_at(max(0, self.last_batch_iteration)))]
+
+    def get_last_lr(self) -> List[float]:
+        return self.get_lr()
+
+    def step(self, last_batch_iteration: Optional[int] = None) -> None:
+        if last_batch_iteration is None:
+            last_batch_iteration = self.last_batch_iteration + 1
+        self.last_batch_iteration = last_batch_iteration
+        if self.optimizer is not None and hasattr(self.optimizer, "set_lr"):
+            self.optimizer.set_lr(self.get_lr()[0])
+
+    def state_dict(self) -> Dict[str, Any]:
+        return {"last_batch_iteration": self.last_batch_iteration}
+
+    def load_state_dict(self, sd: Dict[str, Any]) -> None:
+        self.last_batch_iteration = sd["last_batch_iteration"]
+
+
+class LRRangeTest(_ScheduleBase):
+    """LR range test (Smith 2017): ramp lr by step_rate every step_size steps.
+
+    lr(t) = min_lr * (1 + (t/step_size) * step_rate), continuous or staircase.
+    """
+
+    def __init__(self, optimizer=None, lr_range_test_min_lr: float = 1e-3,
+                 lr_range_test_step_size: int = 2000,
+                 lr_range_test_step_rate: float = 1.0,
+                 lr_range_test_staircase: bool = False,
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        if lr_range_test_step_size <= 0:
+            raise ValueError(f"step_size must be positive, got {lr_range_test_step_size}")
+        self.min_lr = lr_range_test_min_lr
+        self.step_size = lr_range_test_step_size
+        self.step_rate = lr_range_test_step_rate
+        self.staircase = lr_range_test_staircase
+
+    def lr_at(self, step):
+        # Reference interval is (last_batch_iteration + 1) / step_size
+        # (lr_schedules.py:369-373); `step` here is that +1-shifted count.
+        ratio = step / self.step_size
+        if self.staircase:
+            ratio = jnp.floor(ratio) if not isinstance(step, int) else math.floor(ratio)
+        return self.min_lr * (1.0 + ratio * self.step_rate)
+
+    def get_lr(self) -> List[float]:
+        return [float(self.lr_at(self.last_batch_iteration + 1))]
+
+
+class OneCycle(_ScheduleBase):
+    """1-cycle policy: ramp min→max over the first phase, back down over the
+    second, then decay below min. Momentum optionally cycled inversely."""
+
+    def __init__(self, optimizer=None, cycle_min_lr: float = 1e-3,
+                 cycle_max_lr: float = 1e-2, decay_lr_rate: float = 0.0,
+                 cycle_first_step_size: int = 2000,
+                 cycle_second_step_size: Optional[int] = None,
+                 cycle_first_stair_count: int = 0,
+                 cycle_second_stair_count: Optional[int] = None,
+                 decay_step_size: int = 0, cycle_momentum: bool = True,
+                 cycle_min_mom: float = 0.85, cycle_max_mom: float = 0.99,
+                 decay_mom_rate: float = 0.0, last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.cycle_min_lr = cycle_min_lr
+        self.cycle_max_lr = cycle_max_lr
+        self.decay_lr_rate = decay_lr_rate
+        self.first_step_size = cycle_first_step_size
+        self.second_step_size = cycle_second_step_size or cycle_first_step_size
+        self.decay_step_size = decay_step_size
+        self.total_cycle_size = self.first_step_size + self.second_step_size
+        self.cycle_momentum = cycle_momentum
+        self.cycle_min_mom = cycle_min_mom
+        self.cycle_max_mom = cycle_max_mom
+        self.decay_mom_rate = decay_mom_rate
+
+    def _decay_interval(self, step):
+        """Reference decay iteration: step - total_size + 1, scaled by
+        decay_step_size (lr_schedules.py:615-625, 643)."""
+        decay_iter = step - self.total_cycle_size + 1
+        return decay_iter / max(1, self.decay_step_size)
+
+    def lr_at(self, step):
+        in_cycle_lr = self._cycle_lr(step)
+        decayed = self._decay_lr(step)
+        if isinstance(step, int):
+            return in_cycle_lr if step < self.total_cycle_size else decayed
+        return jnp.where(step < self.total_cycle_size, in_cycle_lr, decayed)
+
+    def _cycle_lr(self, step):
+        # Piecewise-linear triangle over [0, first+second].
+        up = self.cycle_min_lr + (self.cycle_max_lr - self.cycle_min_lr) * (
+            step / self.first_step_size)
+        down = self.cycle_max_lr - (self.cycle_max_lr - self.cycle_min_lr) * (
+            (step - self.first_step_size) / self.second_step_size)
+        if isinstance(step, int):
+            return up if step <= self.first_step_size else down
+        return jnp.where(step <= self.first_step_size, up, down)
+
+    def _decay_lr(self, step):
+        # lr = cycle_min_lr / (1 + decay_lr_rate * decay_interval)
+        return self.cycle_min_lr / (1.0 + self.decay_lr_rate * self._decay_interval(step))
+
+    def mom_at(self, step):
+        """Cycled momentum (inverse triangle), decaying upward after the cycle
+        by decay_mom_rate (reference _get_decay_mom, lr_schedules.py:609-613)."""
+        if not self.cycle_momentum:
+            return self.cycle_max_mom
+        up = self.cycle_max_mom - (self.cycle_max_mom - self.cycle_min_mom) * (
+            step / self.first_step_size)
+        down = self.cycle_min_mom + (self.cycle_max_mom - self.cycle_min_mom) * (
+            (step - self.first_step_size) / self.second_step_size)
+        decayed = self.cycle_max_mom * (1.0 + self.decay_mom_rate * self._decay_interval(step))
+        if isinstance(step, int):
+            if step >= self.total_cycle_size:
+                return decayed
+            return up if step <= self.first_step_size else down
+        return jnp.where(step >= self.total_cycle_size, decayed,
+                         jnp.where(step <= self.first_step_size, up, down))
+
+
+class WarmupLR(_ScheduleBase):
+    """Warm up from min_lr to max_lr over warmup_num_steps, then hold.
+
+    warmup_type 'log' uses a logarithmic ramp (reference default), 'linear'
+    a linear one.
+    """
+
+    def __init__(self, optimizer=None, warmup_min_lr: float = 0.0,
+                 warmup_max_lr: float = 0.001, warmup_num_steps: int = 1000,
+                 warmup_type: str = WARMUP_LOG_RATE, last_batch_iteration: int = -1):
+        super().__init__(optimizer, last_batch_iteration)
+        self.min_lr = warmup_min_lr
+        self.max_lr = warmup_max_lr
+        self.warmup_num_steps = max(2, warmup_num_steps)
+        if warmup_type not in (WARMUP_LOG_RATE, WARMUP_LINEAR_RATE):
+            raise ValueError(f"Unknown warmup_type {warmup_type}")
+        self.warmup_type = warmup_type
+        self.inverse_log_warm_up = 1.0 / math.log(self.warmup_num_steps)
+
+    def _gamma(self, step):
+        if self.warmup_type == WARMUP_LOG_RATE:
+            if isinstance(step, int):
+                return self.inverse_log_warm_up * math.log(max(0, step) + 1)
+            return self.inverse_log_warm_up * jnp.log(jnp.maximum(step, 0) + 1.0)
+        return step / self.warmup_num_steps
+
+    def lr_at(self, step):
+        gamma = self._gamma(step)
+        warm = self.min_lr + (self.max_lr - self.min_lr) * gamma
+        if isinstance(step, int):
+            return warm if step < self.warmup_num_steps else self.max_lr
+        return jnp.where(step < self.warmup_num_steps, warm, self.max_lr)
+
+
+class WarmupDecayLR(WarmupLR):
+    """WarmupLR followed by linear decay to 0 at total_num_steps."""
+
+    def __init__(self, optimizer=None, total_num_steps: int = 10000,
+                 warmup_min_lr: float = 0.0, warmup_max_lr: float = 0.001,
+                 warmup_num_steps: int = 1000, warmup_type: str = WARMUP_LOG_RATE,
+                 last_batch_iteration: int = -1):
+        super().__init__(optimizer, warmup_min_lr, warmup_max_lr, warmup_num_steps,
+                         warmup_type, last_batch_iteration)
+        self.total_num_steps = total_num_steps
+        if self.total_num_steps < self.warmup_num_steps:
+            from ..utils.logging import logger
+            logger.warning(
+                f"total_num_steps {total_num_steps} < warmup_num_steps {warmup_num_steps}")
+
+    def lr_at(self, step):
+        # Reference: lr = min_lr + delta_lr * gamma with post-warmup
+        # gamma = max(0, (total - step)/(total - warmup)) — decays to
+        # min_lr, never below it (lr_schedules.py:802-809).
+        warm = super().lr_at(step)
+        denom = max(1.0, self.total_num_steps - self.warmup_num_steps)
+        frac = (self.total_num_steps - step) / denom
+        delta = self.max_lr - self.min_lr
+        if isinstance(step, int):
+            if step < self.warmup_num_steps:
+                return warm
+            return self.min_lr + delta * max(0.0, frac)
+        decay = self.min_lr + delta * jnp.maximum(0.0, frac)
+        return jnp.where(step < self.warmup_num_steps, warm, decay)
+
+
+SCHEDULE_CLASSES = {
+    LR_RANGE_TEST: LRRangeTest,
+    ONE_CYCLE: OneCycle,
+    WARMUP_LR: WarmupLR,
+    WARMUP_DECAY_LR: WarmupDecayLR,
+}
+
+
+def get_lr_schedule(name: str, params: Dict[str, Any], optimizer=None) -> _ScheduleBase:
+    """Instantiate a schedule by ds_config name with its param dict."""
+    if name not in SCHEDULE_CLASSES:
+        raise ValueError(f"Unknown lr schedule {name!r}; valid: {VALID_LR_SCHEDULES}")
+    return SCHEDULE_CLASSES[name](optimizer=optimizer, **params)
+
+
+def add_tuning_arguments(parser):
+    """Argparse plumbing parity (lr_schedules.py:54-298)."""
+    group = parser.add_argument_group("Convergence Tuning", "Convergence tuning configurations")
+    group.add_argument("--lr_schedule", type=str, default=None,
+                       help="LR schedule for training.")
+    group.add_argument("--lr_range_test_min_lr", type=float, default=0.001)
+    group.add_argument("--lr_range_test_step_rate", type=float, default=1.0)
+    group.add_argument("--lr_range_test_step_size", type=int, default=1000)
+    group.add_argument("--lr_range_test_staircase", type=bool, default=False)
+    group.add_argument("--cycle_first_step_size", type=int, default=1000)
+    group.add_argument("--cycle_first_stair_count", type=int, default=1)
+    group.add_argument("--cycle_second_step_size", type=int, default=-1)
+    group.add_argument("--cycle_second_stair_count", type=int, default=-1)
+    group.add_argument("--decay_step_size", type=int, default=1000)
+    group.add_argument("--cycle_min_lr", type=float, default=0.01)
+    group.add_argument("--cycle_max_lr", type=float, default=0.1)
+    group.add_argument("--decay_lr_rate", type=float, default=0.0)
+    group.add_argument("--cycle_momentum", type=bool, default=False)
+    group.add_argument("--cycle_min_mom", type=float, default=0.8)
+    group.add_argument("--cycle_max_mom", type=float, default=0.9)
+    group.add_argument("--decay_mom_rate", type=float, default=0.0)
+    group.add_argument("--warmup_min_lr", type=float, default=0)
+    group.add_argument("--warmup_max_lr", type=float, default=0.001)
+    group.add_argument("--warmup_num_steps", type=int, default=1000)
+    group.add_argument("--warmup_type", type=str, default=WARMUP_LOG_RATE)
+    return parser

@@ -1,0 +1,248 @@
+"""Runtime utilities.
+
+Parity with reference ``runtime/utils.py``:
+- overflow detection (CheckOverflow, utils.py:41-131) → jit-safe pytree
+  inf/nan test; the cross-rank "vote" is a psum inside shard_map, done by the
+  caller.
+- global grad/weight norms with model-parallel filtering (utils.py:148-271)
+- balanced partitioning ``partition_uniform`` / ``partition_balanced``
+  (binary search over prefix sums, utils.py:289-371) — used by pipeline
+  layer placement.
+- ``PartitionedTensor`` (utils.py:373-479): shard a flat tensor over a mesh
+  axis and re-gather; in JAX a thin wrapper over ravel + dynamic slices.
+- memory reporting (utils.py:483-537) → jax device memory stats.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------- #
+# Overflow detection
+# --------------------------------------------------------------------- #
+def tree_has_inf_or_nan(tree: Any) -> jax.Array:
+    """Jit-safe: True iff any leaf contains inf/nan.
+
+    The reference's CheckOverflow does a cross-rank MAX allreduce of this bit
+    (utils.py:41-131); under pjit/shard_map the reduction happens naturally
+    when the caller psums the float indicator.
+    """
+    leaves = [l for l in jax.tree_util.tree_leaves(tree) if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.array(False)
+    flags = [jnp.logical_not(jnp.isfinite(l.astype(jnp.float32)).all()) for l in leaves]
+    return jnp.stack(flags).any()
+
+
+class CheckOverflow:
+    """Host-side convenience wrapper (stateless on TPU)."""
+
+    def __init__(self, param_groups=None, mpu=None, zero_reduce_scatter=False):
+        self.mpu = mpu
+
+    def check(self, tree) -> bool:
+        return bool(jax.device_get(tree_has_inf_or_nan(tree)))
+
+    @staticmethod
+    def has_overflow_serial(tree) -> bool:
+        return bool(jax.device_get(tree_has_inf_or_nan(tree)))
+
+
+# --------------------------------------------------------------------- #
+# Norms
+# --------------------------------------------------------------------- #
+def global_norm(tree: Any, ord: int = 2) -> jax.Array:
+    """L2 (or L1/inf) norm over all leaves of a pytree, jit-safe."""
+    leaves = [l.astype(jnp.float32) for l in jax.tree_util.tree_leaves(tree)
+              if hasattr(l, "dtype")]
+    if not leaves:
+        return jnp.array(0.0)
+    if ord == 2:
+        return jnp.sqrt(sum(jnp.sum(l * l) for l in leaves))
+    if ord == 1:
+        return sum(jnp.sum(jnp.abs(l)) for l in leaves)
+    return jnp.max(jnp.stack([jnp.max(jnp.abs(l)) for l in leaves]))
+
+
+def get_grad_norm(grads: Any, mpu=None, norm_type: int = 2) -> jax.Array:
+    """Parity shim: in SPMD each replica computes the same global norm; the
+    reference's model-parallel duplicate filtering (utils.py:148-205) is
+    unnecessary because sharded grads are already unique per mesh position."""
+    return global_norm(grads, ord=norm_type)
+
+
+def get_weight_norm(params: Any, mpu=None, norm_type: int = 2) -> jax.Array:
+    return global_norm(params, ord=norm_type)
+
+
+def clip_grad_norm_(grads: Any, max_norm: float, norm_type: int = 2,
+                    precomputed_norm: Optional[jax.Array] = None) -> Tuple[Any, jax.Array]:
+    """Return (clipped_grads, total_norm); jit-safe, non-mutating."""
+    total_norm = precomputed_norm if precomputed_norm is not None \
+        else global_norm(grads, ord=norm_type)
+    clip_coef = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    clipped = jax.tree_util.tree_map(
+        lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
+    return clipped, total_norm
+
+
+# --------------------------------------------------------------------- #
+# Balanced partitioning (pipeline layer placement)
+# --------------------------------------------------------------------- #
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Boundaries of `num_parts` near-equal contiguous chunks of `num_items`.
+
+    Returns num_parts+1 offsets, parity with utils.py:289-303.
+    """
+    parts = [0] * (num_parts + 1)
+    if num_items <= num_parts:
+        for p in range(num_parts + 1):
+            parts[p] = min(p, num_items)
+        return parts
+    chunksize = num_items // num_parts
+    residual = num_items - (chunksize * num_parts)
+    parts = list(range(0, (num_parts + 1) * chunksize, chunksize))
+    for i in range(1, residual + 1):
+        parts[i] += i
+    for i in range(residual + 1, num_parts + 1):
+        parts[i] += residual
+    return parts
+
+
+def _lprobe(weights: Sequence[float], num_parts: int, bottleneck: float) -> bool:
+    """Can `weights` be split into num_parts contiguous parts each ≤ bottleneck?"""
+    parts_used = 1
+    current = 0.0
+    for w in weights:
+        if w > bottleneck:
+            return False
+        if current + w > bottleneck:
+            parts_used += 1
+            current = w
+            if parts_used > num_parts:
+                return False
+        else:
+            current += w
+    return True
+
+
+def partition_balanced(weights: Sequence[float], num_parts: int,
+                       eps: float = 1e-3) -> List[int]:
+    """Contiguous partition minimizing the max part weight.
+
+    Binary search over the bottleneck value (parity with utils.py:305-371's
+    prefix-sum search), then greedy placement at the found bottleneck.
+    """
+    num_items = len(weights)
+    if num_items <= num_parts:
+        return partition_uniform(num_items, num_parts)
+
+    weights = [float(w) for w in weights]
+    lo = max(weights)
+    hi = sum(weights)
+    while hi - lo > eps * max(1.0, lo):
+        mid = (lo + hi) / 2
+        if _lprobe(weights, num_parts, mid):
+            hi = mid
+        else:
+            lo = mid
+    bottleneck = hi
+
+    # Greedy split at the bottleneck; then pad to exactly num_parts+1 offsets.
+    parts = [0]
+    current = 0.0
+    for i, w in enumerate(weights):
+        if current + w > bottleneck and i > parts[-1]:
+            parts.append(i)
+            current = w
+        else:
+            current += w
+    while len(parts) < num_parts + 1:
+        parts.append(num_items)
+    parts = parts[:num_parts] + [num_items]
+    return parts
+
+
+def prefix_sum_inc(weights: Sequence[float]) -> List[float]:
+    out: List[float] = []
+    total = 0.0
+    for w in weights:
+        total += float(w)
+        out.append(total)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# PartitionedTensor
+# --------------------------------------------------------------------- #
+class PartitionedTensor:
+    """Shard a tensor's flat view into world_size pieces; re-gather later.
+
+    Parity with utils.py:373-479 (used by the pipeline engine to ship
+    model-parallel activations once instead of world_size times). In JAX the
+    "communication" is the caller's concern (psum/all_gather under shard_map
+    or resharding under pjit); this class provides the deterministic
+    split/merge math so checkpoint shards stay layout-compatible.
+    """
+
+    def __init__(self, tensor: jax.Array, world_size: int, rank: int):
+        self.orig_shape = tensor.shape
+        self.orig_dtype = tensor.dtype
+        self.world_size = world_size
+        self.rank = rank
+        flat = tensor.reshape(-1)
+        self.orig_size = flat.shape[0]
+        padded = int(np.ceil(self.orig_size / world_size)) * world_size
+        self.padded_size = padded
+        if padded != self.orig_size:
+            flat = jnp.pad(flat, (0, padded - self.orig_size))
+        self.part_size = padded // world_size
+        self.local_data = jax.lax.dynamic_slice(
+            flat, (rank * self.part_size,), (self.part_size,))
+
+    @staticmethod
+    def partition_sizes(numel: int, world_size: int) -> List[int]:
+        padded = int(np.ceil(numel / world_size)) * world_size
+        return [padded // world_size] * world_size
+
+    def to_meta(self) -> dict:
+        return {"orig_shape": self.orig_shape, "orig_size": self.orig_size,
+                "world_size": self.world_size, "dtype": str(self.orig_dtype)}
+
+    def full(self, gathered_parts: Sequence[jax.Array]) -> jax.Array:
+        """Reassemble from all shards (caller gathers them)."""
+        flat = jnp.concatenate(list(gathered_parts))[: self.orig_size]
+        return flat.reshape(self.orig_shape).astype(self.orig_dtype)
+
+
+# --------------------------------------------------------------------- #
+# Memory reporting
+# --------------------------------------------------------------------- #
+def see_memory_usage(message: str, force: bool = False) -> None:
+    """Log device memory stats (parity with utils.py:525-537)."""
+    from ..utils.logging import logger
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use", 0) / 2**30
+        peak = stats.get("peak_bytes_in_use", 0) / 2**30
+        limit = stats.get("bytes_limit", 0) / 2**30
+        logger.info(f"{message} | device mem: in_use={in_use:.2f}GB "
+                    f"peak={peak:.2f}GB limit={limit:.2f}GB")
+    except Exception:
+        logger.info(f"{message} | device memory stats unavailable on this backend")
+
+
+def call_to_str(base: str, *args, **kwargs) -> str:
+    name = f"{base}("
+    if args:
+        name += ", ".join(repr(a) for a in args)
+        if kwargs:
+            name += ", "
+    if kwargs:
+        name += ", ".join(f"{k}={v!r}" for k, v in kwargs.items())
+    name += ")"
+    return name

@@ -1,0 +1,73 @@
+"""ZeRO configuration.
+
+Parity with reference ``runtime/zero/config.py``: fields stage,
+contiguous_gradients, reduce_bucket_size, reduce_scatter, overlap_comm,
+allgather_partitions, allgather_bucket_size, load_from_fp32_weights,
+cpu_offload, elastic_checkpoint (zero/config.py:61-107); legacy bool→dict
+migration (zero/config.py:36-53).
+
+TPU mapping notes: bucket sizes become scan-chunk hints for the sharded
+update; ``overlap_comm`` is advisory (XLA's latency-hiding scheduler overlaps
+reduce-scatter with backward automatically); ``cpu_offload`` moves optimizer
+state to TPU-VM host RAM.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from .. import config_utils
+from ... import constants as C
+
+
+class ZeroConfig:
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        self.stage = C.ZERO_STAGE_DEFAULT
+        self.contiguous_gradients = C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT
+        self.reduce_scatter = C.ZERO_REDUCE_SCATTER_DEFAULT
+        self.reduce_bucket_size = C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT
+        self.allgather_partitions = C.ZERO_ALLGATHER_PARTITIONS_DEFAULT
+        self.allgather_bucket_size = C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT
+        self.overlap_comm = C.ZERO_OVERLAP_COMM_DEFAULT
+        self.load_from_fp32_weights = C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT
+        self.cpu_offload = C.ZERO_CPU_OFFLOAD_DEFAULT
+        self.elastic_checkpoint = C.ZERO_ELASTIC_CHECKPOINT_DEFAULT
+        self.max_elements_per_comm = C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
+
+        if param_dict is not None and C.ZERO_OPTIMIZATION in param_dict:
+            zero_config_dict = param_dict[C.ZERO_OPTIMIZATION]
+            if isinstance(zero_config_dict, bool):
+                # Legacy: "zero_optimization": true → stage 1.
+                zero_config_dict = {
+                    C.ZERO_STAGE: 1 if zero_config_dict else 0
+                }
+            self._initialize(zero_config_dict)
+
+    def _initialize(self, d: Dict[str, Any]) -> None:
+        get = config_utils.get_scalar_param
+        self.stage = get(d, C.ZERO_STAGE, C.ZERO_STAGE_DEFAULT)
+        self.contiguous_gradients = get(d, C.ZERO_CONTIGUOUS_GRADIENTS,
+                                        C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT)
+        self.reduce_bucket_size = get(d, C.ZERO_REDUCE_BUCKET_SIZE,
+                                      C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT)
+        self.reduce_scatter = get(d, C.ZERO_REDUCE_SCATTER, C.ZERO_REDUCE_SCATTER_DEFAULT)
+        self.overlap_comm = get(d, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT)
+        self.allgather_partitions = get(d, C.ZERO_ALLGATHER_PARTITIONS,
+                                        C.ZERO_ALLGATHER_PARTITIONS_DEFAULT)
+        self.allgather_bucket_size = get(d, C.ZERO_ALLGATHER_BUCKET_SIZE,
+                                         C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT)
+        self.load_from_fp32_weights = get(d, C.ZERO_LOAD_FROM_FP32_WEIGHTS,
+                                          C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
+        self.cpu_offload = get(d, C.ZERO_CPU_OFFLOAD, C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.elastic_checkpoint = get(d, C.ZERO_ELASTIC_CHECKPOINT,
+                                      C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+        self.max_elements_per_comm = get(d, C.ZERO_MAX_ELEMENTS_PER_COMM,
+                                         C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT)
+        if not isinstance(self.stage, int) or not (0 <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):
+            raise ValueError(
+                f"ZeRO stage must be an int in [0, {C.MAX_STAGE_ZERO_OPTIMIZATION}], got {self.stage}")
+
+    def repr_dict(self) -> Dict[str, Any]:
+        return dict(self.__dict__)
+
+    def __repr__(self) -> str:
+        return f"ZeroConfig({self.repr_dict()})"

@@ -1,0 +1,64 @@
+"""Rank-aware logging.
+
+Parity with reference ``deepspeed/utils/logging.py:7-60``: a singleton logger
+plus ``log_dist(message, ranks=...)`` that only emits on the listed process
+indices (``-1`` = all). On TPU the "rank" is ``jax.process_index()`` when the
+distributed runtime is up, else 0.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+class _LoggerFactory:
+    @staticmethod
+    def create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+        logger_ = logging.getLogger(name)
+        logger_.setLevel(level)
+        logger_.propagate = False
+        if not logger_.handlers:
+            formatter = logging.Formatter(
+                "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+            handler = logging.StreamHandler(stream=sys.stdout)
+            handler.setFormatter(formatter)
+            logger_.addHandler(handler)
+        return logger_
+
+
+logger = _LoggerFactory.create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DS_TPU_LOG_LEVEL", "info"), logging.INFO))
+
+
+def _process_index() -> int:
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def should_log(ranks: Optional[Iterable[int]] = None) -> bool:
+    """True when this process should emit for the given rank filter."""
+    if ranks is None:
+        ranks = [-1]
+    ranks = list(ranks)
+    if -1 in ranks:
+        return True
+    return _process_index() in ranks
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None, level: int = logging.INFO) -> None:
+    """Log ``message`` only on the processes listed in ``ranks``."""
+    if should_log(ranks):
+        logger.log(level, f"[Rank {_process_index()}] {message}")

@@ -1,0 +1,161 @@
+"""Wall-clock and throughput timers.
+
+Parity with reference ``deepspeed/utils/timer.py``:
+- ``SynchronizedWallClockTimer`` (timer.py:26-104): named timers whose
+  start/stop fence outstanding device work. On TPU the fence is
+  ``jax.block_until_ready`` / ``jax.effects_barrier`` rather than
+  ``cuda.synchronize``; dispatch is async in the same way, so unfenced wall
+  clocks under-report.
+- ``ThroughputTimer`` (timer.py:106-183): samples/sec with warm-up steps.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from .logging import logger
+
+
+def _device_sync() -> None:
+    """Block until all dispatched device work is complete."""
+    try:
+        import jax
+        (jax.device_put(0.0) + 0).block_until_ready()
+    except Exception:
+        pass
+
+
+class SynchronizedWallClockTimer:
+    """Named timer group with device-synchronized boundaries."""
+
+    class Timer:
+        def __init__(self, name: str):
+            self.name_ = name
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.start_time = 0.0
+            self.count = 0
+
+        def start(self, synchronize: bool = True) -> None:
+            assert not self.started_, f"timer {self.name_} already started"
+            if synchronize:
+                _device_sync()
+            self.start_time = time.time()
+            self.started_ = True
+
+        def stop(self, reset: bool = False, synchronize: bool = True) -> None:
+            assert self.started_, f"timer {self.name_} not started"
+            if synchronize:
+                _device_sync()
+            if reset:
+                self.elapsed_ = time.time() - self.start_time
+            else:
+                self.elapsed_ += time.time() - self.start_time
+            self.count += 1
+            self.started_ = False
+
+        def reset(self) -> None:
+            self.elapsed_ = 0.0
+            self.started_ = False
+            self.count = 0
+
+        def elapsed(self, reset: bool = True) -> float:
+            started = self.started_
+            count = self.count
+            if started:
+                self.stop(synchronize=False)
+            elapsed = self.elapsed_
+            if reset:
+                self.reset()
+            if started:
+                # Mid-run query: restore count so mean() reflects only real
+                # start/stop cycles.
+                self.count = count
+                self.start(synchronize=False)
+            return elapsed
+
+        def mean(self) -> float:
+            return self.elapsed_ / max(1, self.count)
+
+    def __init__(self):
+        self.timers: Dict[str, SynchronizedWallClockTimer.Timer] = {}
+
+    def __call__(self, name: str) -> "SynchronizedWallClockTimer.Timer":
+        if name not in self.timers:
+            self.timers[name] = self.Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False, ranks: Optional[List[int]] = None) -> str:
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        from .logging import log_dist
+        log_dist(string, ranks=ranks or [0])
+        return string
+
+
+class ThroughputTimer:
+    """Samples/sec tracker with warm-up, parity with timer.py:106-183."""
+
+    def __init__(self, batch_size: int, num_workers: int = 1, start_step: int = 2,
+                 steps_per_output: Optional[int] = None, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.start_time = 0.0
+        self.end_time = 0.0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.num_workers = num_workers
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.steps_per_output = steps_per_output
+        self.monitor_memory = monitor_memory
+        self.logging = logging_fn or logger.info
+        self.initialized = False
+
+    def update_epoch_count(self) -> None:
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def _init_timer(self) -> None:
+        self.initialized = True
+
+    def start(self) -> None:
+        self._init_timer()
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, report_speed: bool = True) -> None:
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync()
+            self.end_time = time.time()
+            duration = self.end_time - self.start_time
+            self.total_elapsed_time += duration
+            if report_speed and self.steps_per_output and \
+                    self.global_step_count % self.steps_per_output == 0:
+                self.logging(
+                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                    f"global_step={self.global_step_count}, "
+                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
+                    f"CurrSamplesPerSec={self.batch_size * self.num_workers / duration:.4f}")
+
+    def avg_samples_per_sec(self) -> float:
+        if self.global_step_count > self.start_step:
+            samples_per_step = self.batch_size * self.num_workers
+            total_step_offset = self.global_step_count - self.start_step
+            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            return samples_per_step / max(avg_time_per_step, 1e-12)
+        return float("-1")

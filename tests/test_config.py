@@ -1,0 +1,143 @@
+"""Config system tests — parity with reference tests/unit/test_config.py and
+test_ds_config.py (batch triple inference, duplicate keys, zero config)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+from deepspeed_tpu.runtime.config_utils import loads_config_json
+
+
+def make_cfg(d, world_size=1):
+    return DeepSpeedConfig(d, world_size=world_size)
+
+
+class TestBatchConfig:
+    def test_all_three_given(self):
+        cfg = make_cfg({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+                        "gradient_accumulation_steps": 2}, world_size=4)
+        assert cfg.train_batch_size == 32
+        assert cfg.train_micro_batch_size_per_gpu == 4
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_infer_grad_acc(self):
+        cfg = make_cfg({"train_batch_size": 32, "train_micro_batch_size_per_gpu": 4},
+                       world_size=4)
+        assert cfg.gradient_accumulation_steps == 2
+
+    def test_infer_micro_batch(self):
+        cfg = make_cfg({"train_batch_size": 32, "gradient_accumulation_steps": 2},
+                       world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 4
+
+    def test_infer_train_batch(self):
+        cfg = make_cfg({"train_micro_batch_size_per_gpu": 4,
+                        "gradient_accumulation_steps": 2}, world_size=4)
+        assert cfg.train_batch_size == 32
+
+    def test_only_train_batch(self):
+        cfg = make_cfg({"train_batch_size": 32}, world_size=4)
+        assert cfg.train_micro_batch_size_per_gpu == 8
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_only_micro_batch(self):
+        cfg = make_cfg({"train_micro_batch_size_per_gpu": 4}, world_size=4)
+        assert cfg.train_batch_size == 16
+        assert cfg.gradient_accumulation_steps == 1
+
+    def test_inconsistent_triple_raises(self):
+        with pytest.raises(AssertionError):
+            make_cfg({"train_batch_size": 33, "train_micro_batch_size_per_gpu": 4,
+                      "gradient_accumulation_steps": 2}, world_size=4)
+
+    def test_no_batch_info_raises(self):
+        with pytest.raises(DeepSpeedConfigError):
+            make_cfg({}, world_size=1)
+
+
+class TestJsonHandling:
+    def test_duplicate_keys_rejected(self):
+        with pytest.raises(ValueError):
+            loads_config_json('{"train_batch_size": 1, "train_batch_size": 2}')
+
+    def test_file_loading(self, tmp_ds_config):
+        path = tmp_ds_config({"train_batch_size": 8})
+        cfg = DeepSpeedConfig(path, world_size=1)
+        assert cfg.train_batch_size == 8
+
+
+class TestPrecision:
+    def test_fp16(self):
+        cfg = make_cfg({"train_batch_size": 8, "fp16": {"enabled": True}})
+        assert cfg.fp16_enabled and not cfg.bf16_enabled
+        assert cfg.precision_dtype == "float16"
+
+    def test_bf16(self):
+        cfg = make_cfg({"train_batch_size": 8, "bf16": {"enabled": True}})
+        assert cfg.precision_dtype == "bfloat16"
+
+    def test_both_raises(self):
+        with pytest.raises(DeepSpeedConfigError):
+            make_cfg({"train_batch_size": 8, "fp16": {"enabled": True},
+                      "bf16": {"enabled": True}})
+
+    def test_fp16_defaults(self):
+        cfg = make_cfg({"train_batch_size": 8, "fp16": {"enabled": True}})
+        assert cfg.fp16_initial_scale_power == 32
+        assert cfg.fp16_loss_scale_window == 1000
+        assert cfg.fp16_hysteresis == 2
+        assert cfg.fp16_min_loss_scale == 1
+
+
+class TestZeroConfig:
+    def test_defaults(self):
+        cfg = make_cfg({"train_batch_size": 8})
+        assert cfg.zero_optimization_stage == 0
+        assert not cfg.zero_enabled
+
+    def test_stage2_with_offload(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                                              "reduce_bucket_size": 1000}})
+        assert cfg.zero_optimization_stage == 2
+        assert cfg.zero_config.cpu_offload
+        assert cfg.zero_config.reduce_bucket_size == 1000
+
+    def test_legacy_bool(self):
+        cfg = make_cfg({"train_batch_size": 8, "zero_optimization": True})
+        assert cfg.zero_optimization_stage == 1
+
+    def test_invalid_stage(self):
+        with pytest.raises(ValueError):
+            make_cfg({"train_batch_size": 8, "zero_optimization": {"stage": 9}})
+
+
+class TestOptimizerScheduler:
+    def test_optimizer_params(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "optimizer": {"type": "Adam", "params": {"lr": 0.001}}})
+        assert cfg.optimizer_name == "adam"
+        assert cfg.optimizer_params["lr"] == 0.001
+
+    def test_scheduler_params(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "scheduler": {"type": "WarmupLR",
+                                      "params": {"warmup_num_steps": 10}}})
+        assert cfg.scheduler_name == "WarmupLR"
+        assert cfg.scheduler_params["warmup_num_steps"] == 10
+
+
+class TestMisc:
+    def test_gradient_clipping(self):
+        cfg = make_cfg({"train_batch_size": 8, "gradient_clipping": 1.0})
+        assert cfg.gradient_clipping == 1.0
+
+    def test_wall_clock_breakdown(self):
+        cfg = make_cfg({"train_batch_size": 8, "wall_clock_breakdown": True})
+        assert cfg.wall_clock_breakdown
+
+    def test_pld(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "progressive_layer_drop": {"enabled": True, "gamma": 0.01}})
+        assert cfg.pld_config.enabled
+        assert cfg.pld_config.gamma == 0.01

@@ -1,0 +1,84 @@
+"""Elasticity tests — parity with reference tests/unit/test_elastic.py."""
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config, get_valid_gpus,
+                                      get_candidate_batch_sizes)
+from deepspeed_tpu.elasticity.config import (ElasticityConfigError,
+                                             ElasticityIncompatibleWorldSize)
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+
+def base_ds_config(**elastic_overrides):
+    elastic = {"enabled": True, "max_train_batch_size": 10000,
+               "micro_batch_sizes": [8, 12, 16, 17], "min_gpus": 32,
+               "max_gpus": 1500, "min_time": 20, "version": 0.1}
+    elastic.update(elastic_overrides)
+    return {"elasticity": elastic}
+
+
+class TestCandidates:
+    def test_candidate_batches(self):
+        cands = get_candidate_batch_sizes([8, 12, 16], 720)
+        # Each base times the largest HCN that fits under max/base.
+        assert 720 in cands   # 8 * 90? No—8*60=480; but 12*60=720 and 16*36=576
+        assert all(c <= 720 * 1 or c in (8, 12, 16) for c in cands)
+
+    def test_valid_gpus(self):
+        valid = get_valid_gpus(batch_size=24, micro_batches=[2, 3], min_valid_gpus=1,
+                               max_valid_gpus=12)
+        # batch 24: micro 2 → up to 12 devices (divisors of 12); micro 3 → divisors of 8.
+        assert set(valid) == {1, 2, 3, 4, 6, 8, 12}
+
+
+class TestComputeElasticConfig:
+    def test_basic(self):
+        batch, valid_gpus, micro = compute_elastic_config(base_ds_config(), "0.1.0")
+        assert micro is None
+        assert batch > 0
+        assert len(valid_gpus) > 0
+        assert all(32 <= g <= 1500 for g in valid_gpus)
+
+    def test_with_world_size(self):
+        _, valid_gpus, _ = compute_elastic_config(base_ds_config(), "0.1.0")
+        ws = valid_gpus[len(valid_gpus) // 2]
+        batch, valid_gpus, micro = compute_elastic_config(base_ds_config(), "0.1.0",
+                                                          world_size=ws)
+        assert ws in valid_gpus
+        assert micro in [8, 12, 16, 17]
+        assert (batch // ws) % micro == 0
+
+    def test_incompatible_world_size(self):
+        cfg = base_ds_config()
+        _, valid_gpus, _ = compute_elastic_config(cfg, "0.1.0")
+        bad = max(valid_gpus) + 1
+        while bad in valid_gpus:
+            bad += 1
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, "0.1.0", world_size=bad)
+
+    def test_future_version_rejected(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_ds_config(version=0.2), "0.1.0")
+
+    def test_empty_micro_batches(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_ds_config(micro_batch_sizes=[]), "0.1.0")
+
+    def test_negative_micro_batches(self):
+        with pytest.raises(ElasticityConfigError):
+            compute_elastic_config(base_ds_config(micro_batch_sizes=[-1, 4]), "0.1.0")
+
+
+class TestConfigIntegration:
+    def test_batch_params_conflict(self):
+        ds = base_ds_config()
+        ds["train_batch_size"] = 128
+        with pytest.raises(ElasticityConfigError):
+            DeepSpeedConfig(ds, world_size=48)
+
+    def test_elastic_config_drives_batch(self):
+        ds = base_ds_config()
+        cfg = DeepSpeedConfig(ds, world_size=48)
+        assert cfg.elasticity_enabled
+        assert cfg.train_batch_size == cfg.train_micro_batch_size_per_gpu * \
+            cfg.gradient_accumulation_steps * 48

@@ -1,0 +1,112 @@
+"""LR schedule tests — parity with reference tests/unit/test_lr_schedulers.py."""
+import math
+
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.runtime.lr_schedules import (LRRangeTest, OneCycle, WarmupLR,
+                                                WarmupDecayLR, get_lr_schedule,
+                                                VALID_LR_SCHEDULES)
+
+
+class TestWarmupLR:
+    def test_endpoints(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=100)
+        assert s.lr_at(0) < 0.02
+        assert s.lr_at(100) == pytest.approx(0.1)
+        assert s.lr_at(10_000) == pytest.approx(0.1)
+
+    def test_monotone(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=0.1, warmup_num_steps=50)
+        lrs = [s.lr_at(t) for t in range(0, 60)]
+        assert all(b >= a - 1e-9 for a, b in zip(lrs, lrs[1:]))
+
+    def test_linear(self):
+        s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                     warmup_type="linear")
+        assert s.lr_at(5) == pytest.approx(0.5)
+
+    def test_traced_matches_python(self):
+        s = WarmupLR(warmup_min_lr=0.01, warmup_max_lr=0.1, warmup_num_steps=100)
+        for t in [0, 1, 50, 99, 100, 500]:
+            assert float(s.lr_at(jnp.array(t, jnp.float32))) == pytest.approx(
+                s.lr_at(t), rel=1e-5)
+
+
+class TestWarmupDecayLR:
+    def test_decays_to_zero(self):
+        s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.0,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+        assert s.lr_at(10) == pytest.approx(0.1)
+        assert s.lr_at(55) == pytest.approx(0.1 * 0.5, rel=1e-6)
+        assert s.lr_at(100) == pytest.approx(0.0)
+        assert s.lr_at(200) == pytest.approx(0.0)  # clamped, never negative
+
+    def test_traced_matches_python(self):
+        s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=0.1, warmup_num_steps=10)
+        for t in [0, 5, 10, 50, 100, 150]:
+            assert float(s.lr_at(jnp.array(t, jnp.float32))) == pytest.approx(
+                s.lr_at(t), rel=1e-5, abs=1e-8)
+
+    def test_decays_to_min_lr_not_zero(self):
+        # Reference decays lr to warmup_min_lr, never below
+        # (lr_schedules.py:802-809).
+        s = WarmupDecayLR(total_num_steps=100, warmup_min_lr=0.02,
+                          warmup_max_lr=0.1, warmup_num_steps=10)
+        assert s.lr_at(100) == pytest.approx(0.02)
+        assert s.lr_at(1000) == pytest.approx(0.02)
+        assert all(s.lr_at(t) >= 0.02 - 1e-9 for t in range(0, 200, 7))
+
+
+class TestLRRangeTest:
+    def test_continuous(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0)
+        assert s.lr_at(0) == pytest.approx(0.01)
+        assert s.lr_at(10) == pytest.approx(0.02)
+
+    def test_staircase(self):
+        s = LRRangeTest(lr_range_test_min_lr=0.01, lr_range_test_step_size=10,
+                        lr_range_test_step_rate=1.0, lr_range_test_staircase=True)
+        assert s.lr_at(9) == pytest.approx(0.01)
+        assert s.lr_at(10) == pytest.approx(0.02)
+
+    def test_bad_step_size(self):
+        with pytest.raises(ValueError):
+            LRRangeTest(lr_range_test_step_size=0)
+
+
+class TestOneCycle:
+    def test_triangle(self):
+        s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=100)
+        assert s.lr_at(0) == pytest.approx(0.01)
+        assert s.lr_at(100) == pytest.approx(0.1)
+        assert s.lr_at(200) == pytest.approx(0.01)
+
+    def test_momentum_inverse(self):
+        s = OneCycle(cycle_min_lr=0.01, cycle_max_lr=0.1, cycle_first_step_size=100,
+                     cycle_min_mom=0.85, cycle_max_mom=0.99)
+        assert s.mom_at(0) == pytest.approx(0.99)
+        assert s.mom_at(100) == pytest.approx(0.85)
+        assert s.mom_at(200) == pytest.approx(0.99)
+
+
+class TestFactory:
+    def test_by_name(self):
+        s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10})
+        assert isinstance(s, WarmupLR)
+
+    def test_unknown(self):
+        with pytest.raises(ValueError):
+            get_lr_schedule("Bogus", {})
+
+    def test_stateful_step_api(self):
+        s = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10})
+        s.step()
+        s.step()
+        assert s.last_batch_iteration == 1
+        sd = s.state_dict()
+        s2 = get_lr_schedule("WarmupLR", {"warmup_max_lr": 0.1, "warmup_num_steps": 10})
+        s2.load_state_dict(sd)
+        assert s2.last_batch_iteration == 1
+        assert len(VALID_LR_SCHEDULES) == 4

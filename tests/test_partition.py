@@ -1,0 +1,90 @@
+"""Partitioning + runtime util tests — parity with reference
+tests/unit/test_partition.py (partition_balanced, PartitionedTensor) and the
+CheckOverflow/norm helpers."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.utils import (partition_uniform, partition_balanced,
+                                         PartitionedTensor, tree_has_inf_or_nan,
+                                         global_norm, clip_grad_norm_)
+
+
+class TestPartitionUniform:
+    def test_even(self):
+        assert partition_uniform(8, 4) == [0, 2, 4, 6, 8]
+
+    def test_residual(self):
+        parts = partition_uniform(10, 4)
+        assert parts[0] == 0 and parts[-1] == 10
+        sizes = [b - a for a, b in zip(parts, parts[1:])]
+        assert max(sizes) - min(sizes) <= 1
+
+    def test_fewer_items_than_parts(self):
+        parts = partition_uniform(2, 4)
+        assert parts == [0, 1, 2, 2, 2]
+
+
+class TestPartitionBalanced:
+    def test_uniform_weights(self):
+        parts = partition_balanced([1.0] * 8, 4)
+        assert parts == [0, 2, 4, 6, 8]
+
+    def test_skewed(self):
+        weights = [10.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 1.0]
+        parts = partition_balanced(weights, 2)
+        assert parts[0] == 0 and parts[-1] == 8
+        # Heavy first item should be alone-ish: max part weight near 10.
+        loads = [sum(weights[a:b]) for a, b in zip(parts, parts[1:])]
+        assert max(loads) <= 11.0
+
+    def test_monotone_boundaries(self):
+        parts = partition_balanced([3, 1, 4, 1, 5, 9, 2, 6], 3)
+        assert all(b >= a for a, b in zip(parts, parts[1:]))
+        assert parts[0] == 0 and parts[-1] == 8
+
+
+class TestPartitionedTensor:
+    def test_round_trip(self):
+        x = jnp.arange(23, dtype=jnp.float32).reshape(23)
+        world = 4
+        parts = [PartitionedTensor(x, world, r) for r in range(world)]
+        full = parts[0].full([p.local_data for p in parts])
+        np.testing.assert_allclose(np.asarray(full), np.asarray(x))
+
+    def test_2d_round_trip(self):
+        x = jnp.arange(30, dtype=jnp.bfloat16).reshape(5, 6)
+        world = 4
+        parts = [PartitionedTensor(x, world, r) for r in range(world)]
+        full = parts[0].full([p.local_data for p in parts])
+        assert full.shape == (5, 6)
+        assert full.dtype == jnp.bfloat16
+
+
+class TestOverflowAndNorms:
+    def test_no_overflow(self):
+        tree = {"a": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+        assert not bool(tree_has_inf_or_nan(tree))
+
+    def test_nan(self):
+        tree = {"a": jnp.array([1.0, jnp.nan])}
+        assert bool(tree_has_inf_or_nan(tree))
+
+    def test_inf(self):
+        tree = {"a": jnp.array([1.0, jnp.inf])}
+        assert bool(tree_has_inf_or_nan(tree))
+
+    def test_jittable(self):
+        f = jax.jit(tree_has_inf_or_nan)
+        assert bool(f({"a": jnp.array([jnp.inf])}))
+
+    def test_global_norm(self):
+        tree = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+        assert float(global_norm(tree)) == pytest.approx(5.0)
+
+    def test_clip(self):
+        tree = {"a": jnp.array([3.0]), "b": jnp.array([4.0])}
+        clipped, norm = clip_grad_norm_(tree, max_norm=1.0)
+        assert float(norm) == pytest.approx(5.0)
+        assert float(global_norm(clipped)) == pytest.approx(1.0, rel=1e-4)

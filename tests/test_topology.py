@@ -1,0 +1,106 @@
+"""Topology tests — parity with reference tests/unit/test_topology.py."""
+import pytest
+
+from deepspeed_tpu.parallel.topology import (ProcessTopology, PipeDataParallelTopology,
+                                             PipeModelDataParallelTopology,
+                                             PipelineParallelGrid, build_mesh)
+
+
+class TestProcessTopology:
+    def test_rank_coord_roundtrip(self):
+        topo = ProcessTopology(axes=["x", "y"], dims=[2, 3])
+        assert topo.world_size() == 6
+        for r in range(6):
+            coord = topo.get_coord(r)
+            assert topo.get_rank(x=coord.x, y=coord.y) == r
+
+    def test_row_major(self):
+        topo = ProcessTopology(axes=["x", "y"], dims=[2, 2])
+        assert topo.get_rank(x=0, y=0) == 0
+        assert topo.get_rank(x=0, y=1) == 1
+        assert topo.get_rank(x=1, y=0) == 2
+        assert topo.get_rank(x=1, y=1) == 3
+
+    def test_axis_comm_lists(self):
+        topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 2])
+        data_lists = topo.get_axis_comm_lists("data")
+        assert [0, 1] in data_lists and [2, 3] in data_lists
+        pipe_lists = topo.get_axis_comm_lists("pipe")
+        assert [0, 2] in pipe_lists and [1, 3] in pipe_lists
+
+    def test_filter_match(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        ranks = topo.filter_match(pipe=0)
+        assert len(ranks) == 4
+        assert all(topo.get_coord(r).pipe == 0 for r in ranks)
+
+    def test_get_axis_list(self):
+        topo = ProcessTopology(axes=["a", "b"], dims=[2, 4])
+        assert topo.get_axis_list("a", 1) == [4, 5, 6, 7]
+
+    def test_rank_repr(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=1)
+        # model axis survives default omission of data/pipe
+        assert "model" in topo.get_rank_repr(0)
+
+    def test_missing_axis_dim_zero(self):
+        topo = ProcessTopology(axes=["x"], dims=[4])
+        assert topo.get_dim("nope") == 0
+
+
+class Test3DTopology:
+    def test_3d_sizes(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        assert topo.world_size() == 8
+        assert topo.get_dim("pipe") == 2
+        assert topo.get_dim("model") == 2
+        assert topo.get_dim("data") == 2
+
+    def test_model_axis_innermost(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        # ranks 0 and 1 should differ only in the model coordinate
+        c0, c1 = topo.get_coord(0), topo.get_coord(1)
+        assert c0.pipe == c1.pipe and c0.data == c1.data and c0.model != c1.model
+
+
+class TestGrid:
+    def test_mpu_contract(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        grid = PipelineParallelGrid(topology=topo, global_rank=3)
+        coord = topo.get_coord(3)
+        assert grid.get_pipe_parallel_rank() == coord.pipe
+        assert grid.get_data_parallel_rank() == coord.data
+        assert grid.get_model_parallel_rank() == coord.model
+        assert grid.get_data_parallel_world_size() == 2
+        assert grid.get_model_parallel_world_size() == 2
+        assert grid.get_pipe_parallel_world_size() == 2
+        # slice parallel aliases model parallel (topology.py:445-455)
+        assert grid.get_slice_parallel_rank() == grid.get_model_parallel_rank()
+
+    def test_stage_mapping(self):
+        topo = PipeDataParallelTopology(num_pp=4, num_dp=2)
+        grid = PipelineParallelGrid(topology=topo, global_rank=0)
+        assert grid.is_first_stage()
+        assert not grid.is_last_stage()
+        # all stage ranks share this rank's data coord
+        for s in range(4):
+            r = grid.stage_to_global_rank(s)
+            assert topo.get_coord(r).pipe == s
+            assert topo.get_coord(r).data == 0
+
+
+class TestMesh:
+    def test_build_8dp(self):
+        mesh = build_mesh()
+        assert mesh.shape["data"] == 8
+        assert mesh.shape["model"] == 1
+
+    def test_build_2x2x2(self):
+        mesh = build_mesh(dp=2, mp=2, pp=2)
+        assert mesh.shape["data"] == 2
+        assert mesh.shape["model"] == 2
+        assert mesh.shape["pipe"] == 2
+
+    def test_bad_factorization(self):
+        with pytest.raises(AssertionError):
+            build_mesh(dp=3, mp=3)
