@@ -1,0 +1,134 @@
+"""Data loading.
+
+Parity with reference ``runtime/dataloader.py``: ``DeepSpeedDataLoader``
+(auto distributed sampling over the dp axis, dataloader.py:33-101) and
+``RepeatingLoader`` (dataloader.py:10).
+
+TPU-native design: one JAX process feeds all local chips, so the loader
+yields *global per-process* batches as stacked numpy arrays, which the engine
+shards over the mesh dp axis via NamedSharding (device layout is the engine's
+job, matching how the reference's sampler + ``to(device)`` split duties).
+Accepts torch datasets/dataloaders, numpy arrays, or any indexable.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """Wrap an iterator to restart on StopIteration (dataloader.py:10)."""
+
+    def __init__(self, loader: Iterable):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self.loader)
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+def default_collate(samples: Sequence[Any]):
+    """Stack a list of samples (arrays / tuples / dicts of arrays)."""
+    first = samples[0]
+    if isinstance(first, (tuple, list)):
+        return type(first)(default_collate([s[i] for s in samples])
+                           for i in range(len(first)))
+    if isinstance(first, dict):
+        return {k: default_collate([s[k] for s in samples]) for k in first}
+    arrs = [np.asarray(s) for s in samples]
+    return np.stack(arrs)
+
+
+class DeepSpeedDataLoader:
+    """Batched, optionally shuffled, per-process-sharded loader.
+
+    Parity with dataloader.py:33-101: the reference builds a
+    ``DistributedSampler(rank=dp_rank, num_replicas=dp_size)``; here each
+    *process* takes an interleaved shard of the dataset (process boundary =
+    host, since one process drives many chips) and yields batches of
+    ``batch_size`` = per-process batch (micro_batch × local dp × grad_acc
+    as the engine requests).
+    """
+
+    def __init__(self, dataset: Any, batch_size: int,
+                 collate_fn: Optional[Callable] = None,
+                 local_rank: int = -1,
+                 num_local_io_workers: Optional[int] = None,
+                 data_sampler: Optional[Any] = None,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = True,
+                 data_parallel_world_size: Optional[int] = None,
+                 data_parallel_rank: Optional[int] = None):
+        import jax
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collate_fn = collate_fn or default_collate
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.dp_world = (data_parallel_world_size if data_parallel_world_size
+                         is not None else jax.process_count())
+        self.dp_rank = (data_parallel_rank if data_parallel_rank is not None
+                        else jax.process_index())
+        self.data_sampler = data_sampler
+        self._len = self._shard_len() // batch_size if drop_last else \
+            -(-self._shard_len() // batch_size)
+
+    def _dataset_len(self) -> int:
+        return len(self.dataset)
+
+    def _shard_len(self) -> int:
+        n = self._dataset_len()
+        return n // self.dp_world if self.drop_last else \
+            len(range(self.dp_rank, n, self.dp_world))
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        return self._len
+
+    def __iter__(self) -> Iterator[Any]:
+        n = self._dataset_len()
+        order = np.arange(n)
+        epoch = self.epoch
+        # Each fresh iterator is a new epoch (set_epoch still overrides).
+        self.epoch += 1
+        if self.shuffle:
+            rng = np.random.default_rng(self.seed + epoch)
+            rng.shuffle(order)
+        # Interleaved shard per process (DistributedSampler semantics).
+        shard = order[self.dp_rank::self.dp_world]
+        usable = (len(shard) // self.batch_size) * self.batch_size \
+            if self.drop_last else len(shard)
+        for start in range(0, usable, self.batch_size):
+            idxs = shard[start:start + self.batch_size]
+            samples = [self.dataset[int(i)] for i in idxs]
+            yield self.collate_fn(samples)
+
+
+class ArrayDataset:
+    """Tuple-of-arrays dataset: sample i = (arr[i] for each array)."""
+
+    def __init__(self, *arrays: np.ndarray):
+        assert arrays and all(len(a) == len(arrays[0]) for a in arrays)
+        self.arrays = [np.asarray(a) for a in arrays]
+
+    def __len__(self) -> int:
+        return len(self.arrays[0])
+
+    def __getitem__(self, i: int):
+        if len(self.arrays) == 1:
+            return self.arrays[0][i]
+        return tuple(a[i] for a in self.arrays)

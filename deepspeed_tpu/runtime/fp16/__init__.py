@@ -1,0 +1,2 @@
+from .loss_scaler import (LossScaler, DynamicLossScaler, LossScaleState,
+                          make_loss_scale_state, update_loss_scale)

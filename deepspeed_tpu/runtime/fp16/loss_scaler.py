@@ -1,0 +1,148 @@
+"""Loss scaling.
+
+Parity with reference ``runtime/fp16/loss_scaler.py``: ``LossScaler``
+(static, loss_scaler.py:34), ``DynamicLossScaler`` (loss_scaler.py:79-166):
+×2 every ``scale_window`` clean steps, ÷2 on overflow with a ``min_scale``
+floor and ``delayed_shift`` hysteresis.
+
+TPU-native design: the scaler state is a small pytree of arrays
+(``LossScaleState``) carried through the jitted train step; ``update`` is a
+pure function the engine calls under ``lax.cond``-free arithmetic (all
+branches are ``jnp.where``). The classes below wrap the pure core for
+reference-API parity. bf16 training needs none of this and uses scale 1.
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+
+class LossScaleState(NamedTuple):
+    loss_scale: jnp.ndarray      # f32 scalar
+    growth_count: jnp.ndarray    # i32: clean steps since last change
+    hysteresis: jnp.ndarray      # i32: remaining tolerated overflows
+    dynamic: bool                # static python flag
+    scale_window: int
+    min_scale: float
+    hysteresis_init: int
+    scale_factor: float
+
+
+def make_loss_scale_state(initial_scale: float = 2.0 ** 32, dynamic: bool = True,
+                          scale_window: int = 1000, min_scale: float = 1.0,
+                          hysteresis: int = 2, scale_factor: float = 2.0) -> LossScaleState:
+    return LossScaleState(
+        loss_scale=jnp.asarray(initial_scale, jnp.float32),
+        growth_count=jnp.asarray(0, jnp.int32),
+        hysteresis=jnp.asarray(hysteresis, jnp.int32),
+        dynamic=dynamic, scale_window=scale_window, min_scale=min_scale,
+        hysteresis_init=hysteresis, scale_factor=scale_factor)
+
+
+def update_loss_scale(state: LossScaleState, overflow: jnp.ndarray) -> LossScaleState:
+    """Pure jit-safe update (reference loss_scaler.py:120-146 semantics):
+
+    - overflow & hysteresis exhausted → scale = max(scale/factor, min_scale)
+    - overflow & hysteresis left → consume one hysteresis credit
+    - clean step → growth_count+=1; at scale_window, scale *= factor
+    """
+    if not state.dynamic:
+        return state
+    overflow = overflow.astype(jnp.bool_)
+    hys_left = state.hysteresis > 1
+    new_scale_on_overflow = jnp.where(
+        hys_left, state.loss_scale,
+        jnp.maximum(state.loss_scale / state.scale_factor, state.min_scale))
+    new_hys_on_overflow = jnp.where(hys_left, state.hysteresis - 1, state.hysteresis)
+
+    grown = (state.growth_count + 1) % state.scale_window == 0
+    new_scale_clean = jnp.where(grown, state.loss_scale * state.scale_factor,
+                                state.loss_scale)
+    # Growth window also restores hysteresis credits (reference
+    # DynamicLossScaler resets cur_hysteresis = delayed_shift at the window,
+    # loss_scaler.py:137-146).
+    new_hys_clean = jnp.where(grown, state.hysteresis_init, state.hysteresis)
+
+    return state._replace(
+        loss_scale=jnp.where(overflow, new_scale_on_overflow, new_scale_clean),
+        growth_count=jnp.where(overflow, 0, state.growth_count + 1).astype(jnp.int32),
+        hysteresis=jnp.where(overflow, new_hys_on_overflow, new_hys_clean)
+        .astype(jnp.int32))
+
+
+# --------------------------------------------------------------------- #
+# Reference-parity class API
+# --------------------------------------------------------------------- #
+class LossScalerBase:
+    def __init__(self, cur_scale: float):
+        self.cur_scale = cur_scale
+
+    @property
+    def loss_scale(self) -> float:
+        return self.cur_scale
+
+    def scale_gradient(self, grads):
+        import jax
+        return jax.tree_util.tree_map(lambda g: g * self.cur_scale, grads)
+
+    def update_scale(self, overflow: bool) -> None:
+        pass
+
+    def backward(self, loss):
+        return loss * self.cur_scale
+
+
+class LossScaler(LossScalerBase):
+    """Static loss scale (loss_scaler.py:34)."""
+
+    def __init__(self, scale: float = 1.0):
+        super().__init__(scale)
+
+    def has_overflow(self, params) -> bool:
+        return False
+
+
+class DynamicLossScaler(LossScalerBase):
+    """Dynamic loss scale (loss_scaler.py:79)."""
+
+    def __init__(self, init_scale: float = 2.0 ** 32, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False):
+        super().__init__(init_scale)
+        self.cur_iter = 0
+        self.last_overflow_iter = -1
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_scale = min_scale
+        self.delayed_shift = delayed_shift
+        self.cur_hysteresis = delayed_shift
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def has_overflow_serial(self, tree) -> bool:
+        from ..utils import tree_has_inf_or_nan
+        import jax
+        return bool(jax.device_get(tree_has_inf_or_nan(tree)))
+
+    def update_scale(self, overflow: bool) -> None:
+        if overflow:
+            if self.delayed_shift == 1 or self.cur_hysteresis == 1:
+                self.cur_scale = max(self.cur_scale / self.scale_factor, self.min_scale)
+            else:
+                self.cur_hysteresis -= 1
+            self.last_overflow_iter = self.cur_iter
+        else:
+            if self.consecutive_hysteresis:
+                self.cur_hysteresis = self.delayed_shift
+            if (self.cur_iter - self.last_overflow_iter) % self.scale_window == 0:
+                if not self.consecutive_hysteresis:
+                    self.cur_hysteresis = self.delayed_shift
+                self.cur_scale *= self.scale_factor
+        self.cur_iter += 1
+
+
+# ds_config key names (reference loss_scaler.py:170-221 CreateLossScaler)
+INITIAL_LOSS_SCALE = "init_scale"
+SCALE_WINDOW = "scale_window"
+DELAYED_SHIFT = "delayed_shift"
+MIN_LOSS_SCALE = "min_scale"

@@ -1,0 +1,185 @@
+"""Pipeline model expression.
+
+Parity with reference ``runtime/pipe/module.py``: ``LayerSpec`` (module.py:23
+— delayed construction so each stage builds only its own layers),
+``TiedLayerSpec`` (module.py:71 — e.g. shared embedding/unembedding),
+``PipelineModule`` (module.py:85) with partitioning methods ``uniform`` /
+``parameters`` / ``type:regex`` (module.py:348-404) over
+``partition_uniform``/``partition_balanced``.
+
+TPU-native design: a "layer" is a pure function (or flax module) taking the
+activation pytree; the PipelineModule compiles each *stage* to one fused
+function layers[lo:hi] which the pipeline engine maps over the pp mesh axis.
+Per-layer deterministic seeding (module.py:200-206) becomes fold_in(layer_idx).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..utils import partition_balanced, partition_uniform
+from ...utils.logging import logger
+
+
+class LayerSpec:
+    """Delayed layer construction: store class + args, build per stage."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+        if not issubclass(typename, object):
+            raise RuntimeError("LayerSpec requires a class")
+
+    def build(self, log: bool = False):
+        if log:
+            logger.info(f"building {repr(self)}")
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self) -> str:
+        from ..utils import call_to_str
+        return call_to_str(self.typename.__name__, *self.module_args,
+                           **self.module_kwargs)
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose parameters are shared with every other spec of the same
+    ``key`` (reference module.py:71; used for tied embeddings). The pipeline
+    engine reduces tied-weight grads across the owning stages
+    (ReduceTiedGrads parity)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 tied_weight_attr="embedding", **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+        self.tied_weight_attr = tied_weight_attr
+
+
+class PipelineModule:
+    """A model as a list of layers, partitioned into pipeline stages.
+
+    ``layers``: sequence of LayerSpec / callables / flax modules. A callable
+    layer is used as ``fn(params_i, x, rng) -> x`` when it accepts params, or
+    ``fn(x) -> x`` for stateless ops.
+    """
+
+    def __init__(self, layers: Sequence[Any], num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seed_layers: bool = False, base_seed: int = 1234,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        self._layer_specs = list(layers)
+        self.loss_fn = loss_fn
+        self.seed_layers = seed_layers
+        self.base_seed = base_seed
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self._topo = topology
+
+        if topology is not None:
+            self.num_stages = topology.get_dim("pipe")
+        else:
+            self.num_stages = num_stages if num_stages is not None else 1
+
+        # Build all layers (single-control SPMD: one process owns the whole
+        # program; stage locality is a sharding property, not a build
+        # property — unlike the reference's per-rank partial build).
+        self.layers = [self._build_layer(i, spec)
+                       for i, spec in enumerate(self._layer_specs)]
+        self.parts = self._partition_layers()
+        # key → all layer indices sharing that parameter set.
+        self.tied_specs: Dict[str, List[int]] = {}
+        for i, spec in enumerate(self._layer_specs):
+            if isinstance(spec, TiedLayerSpec):
+                self.tied_specs.setdefault(spec.key, []).append(i)
+
+    def param_key(self, layer_idx: int) -> str:
+        """Param-tree key for a layer; tied layers share one key, which is
+        what makes their weights (and their grad accumulation — the
+        ReduceTiedGrads instruction in the reference) actually shared."""
+        spec = self._layer_specs[layer_idx]
+        if isinstance(spec, TiedLayerSpec):
+            return f"tied_{spec.key}"
+        return f"layer_{layer_idx}"
+
+    def layer_spec(self, layer_idx: int):
+        return self._layer_specs[layer_idx]
+
+    def mpu(self):
+        return self._topo
+
+    def topology(self):
+        return self._topo
+
+    def _build_layer(self, idx: int, spec):
+        if isinstance(spec, LayerSpec):
+            return spec.build()
+        return spec
+
+    # ------------------------------------------------------------------ #
+    def _count_layer_params(self) -> List[float]:
+        """Per-layer parameter counts for balanced partitioning."""
+        counts = []
+        for layer in self.layers:
+            n = 0
+            if hasattr(layer, "param_count"):
+                n = layer.param_count()
+            elif hasattr(layer, "params") and layer.params is not None:
+                n = sum(np.prod(l.shape) for l in
+                        jax.tree_util.tree_leaves(layer.params))
+            counts.append(float(max(n, 1)))
+        return counts
+
+    def _partition_layers(self) -> List[int]:
+        """Stage boundaries (module.py:348-404)."""
+        num_layers = len(self.layers)
+        method = (self.partition_method or "parameters").lower()
+        if method == "uniform":
+            parts = partition_uniform(num_layers, self.num_stages)
+        elif method == "parameters":
+            parts = partition_balanced(self._count_layer_params(), self.num_stages)
+        elif method.startswith("type:"):
+            regex = method.split(":", 1)[1]
+            weights = [1.0 if re.search(regex, type(l).__name__, re.IGNORECASE)
+                       else 0.0 for l in self.layers]
+            # Avoid empty stages when few matches: give epsilon weight.
+            weights = [w if w > 0 else 1e-6 for w in weights]
+            parts = partition_balanced(weights, self.num_stages)
+        elif method == "profile":
+            raise NotImplementedError("profile partitioning arrives with the "
+                                      "runtime profiler")
+        else:
+            raise KeyError(f"unknown partition method {self.partition_method}")
+        return parts
+
+    def stage_layers(self, stage_id: int) -> List[Any]:
+        lo, hi = self.parts[stage_id], self.parts[stage_id + 1]
+        return self.layers[lo:hi]
+
+    def stage_owner(self, layer_idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    def layer_rng(self, layer_idx: int, base_rng):
+        """Per-layer deterministic seeding (module.py:200-206)."""
+        if self.seed_layers:
+            return jax.random.fold_in(base_rng, self.base_seed + layer_idx)
+        return base_rng
+
+    def __len__(self) -> int:
+        return len(self.layers)
+
+    def describe(self) -> str:
+        lines = [f"PipelineModule: {len(self.layers)} layers over "
+                 f"{self.num_stages} stages ({self.partition_method})"]
+        for s in range(self.num_stages):
+            lo, hi = self.parts[s], self.parts[s + 1]
+            names = [type(l).__name__ for l in self.layers[lo:hi]]
+            lines.append(f"  stage {s}: layers {lo}..{hi - 1} {names}")
+        return "\n".join(lines)

@@ -1,0 +1,34 @@
+"""Progressive layer drop.
+
+Parity with reference ``runtime/progressive_layer_drop.py``: per-step keep
+probability theta(t) = (1 - theta_f) * exp(-gamma * t) + theta_f
+(progressive_layer_drop.py:29-37). The engine advances it each step and
+models consume ``get_theta()`` (jit-safe pure form: ``theta_at(step)``).
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def theta_at(self, step):
+        """Jit-safe keep-prob at a given global step."""
+        if isinstance(step, int):
+            return (1.0 - self.theta) * math.exp(-self.gamma * step) + self.theta
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * step) + self.theta
+
+    def update_state(self, global_step) -> None:
+        self.current_theta = float(self.theta_at(int(global_step)))
+
+    def get_state(self) -> dict:
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta()}
+
+    def get_theta(self) -> float:
+        return self.current_theta

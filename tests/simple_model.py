@@ -1,0 +1,54 @@
+"""Test fixture models — parity with reference tests/unit/simple_model.py
+(SimpleModel: one linear + CE; random_dataloader; args_from_dict)."""
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def simple_model_params(rng, dim=8, num_classes=4, hidden=16):
+    k1, k2 = jax.random.split(rng)
+    return {
+        "w1": jax.random.normal(k1, (dim, hidden)) * 0.1,
+        "b1": jnp.zeros((hidden,)),
+        "w2": jax.random.normal(k2, (hidden, num_classes)) * 0.1,
+        "b2": jnp.zeros((num_classes,)),
+    }
+
+
+def simple_loss_fn(params, batch, rng):
+    """Two-layer MLP with cross-entropy loss (SimpleModel analog)."""
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(y, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def random_dataset(n=64, dim=8, num_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    # Learnable labels: y depends on x so loss can fall.
+    y = (x.sum(axis=1) > 0).astype(np.int32) % num_classes
+    from deepspeed_tpu.runtime.dataloader import ArrayDataset
+    return ArrayDataset(x, y)
+
+
+def random_batch(n=16, dim=8, num_classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) % num_classes
+    return (x, y)
+
+
+def base_config(**overrides):
+    cfg = {
+        "train_batch_size": 16,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "steps_per_print": 1000,
+    }
+    cfg.update(overrides)
+    return cfg

@@ -1,0 +1,74 @@
+"""Dataloader tests — parity with reference tests/unit/test_data.py."""
+import numpy as np
+
+from deepspeed_tpu.runtime.dataloader import (DeepSpeedDataLoader, RepeatingLoader,
+                                              ArrayDataset, default_collate)
+
+
+def make_ds(n=32, dim=4):
+    x = np.arange(n * dim, dtype=np.float32).reshape(n, dim)
+    y = np.arange(n, dtype=np.int32)
+    return ArrayDataset(x, y)
+
+
+class TestDeepSpeedDataLoader:
+    def test_batching(self):
+        dl = DeepSpeedDataLoader(make_ds(32), batch_size=8,
+                                 data_parallel_world_size=1, data_parallel_rank=0)
+        batches = list(dl)
+        assert len(batches) == 4 == len(dl)
+        xb, yb = batches[0]
+        assert xb.shape == (8, 4) and yb.shape == (8,)
+
+    def test_sharding_disjoint(self):
+        seen = []
+        for rank in range(4):
+            dl = DeepSpeedDataLoader(make_ds(32), batch_size=4,
+                                     data_parallel_world_size=4,
+                                     data_parallel_rank=rank)
+            for _, yb in dl:
+                seen.extend(yb.tolist())
+        assert sorted(seen) == list(range(32))
+
+    def test_shuffle_reproducible_across_ranks(self):
+        # Same epoch+seed ⇒ same permutation ⇒ shards stay disjoint.
+        all_ids = []
+        for rank in range(2):
+            dl = DeepSpeedDataLoader(make_ds(16), batch_size=8, shuffle=True,
+                                     seed=3, data_parallel_world_size=2,
+                                     data_parallel_rank=rank)
+            for _, yb in dl:
+                all_ids.extend(yb.tolist())
+        assert sorted(all_ids) == list(range(16))
+
+    def test_drop_last(self):
+        dl = DeepSpeedDataLoader(make_ds(30), batch_size=8,
+                                 data_parallel_world_size=1, data_parallel_rank=0)
+        assert len(list(dl)) == 3
+
+    def test_epoch_reshuffles(self):
+        dl = DeepSpeedDataLoader(make_ds(16), batch_size=16, shuffle=True, seed=0,
+                                 data_parallel_world_size=1, data_parallel_rank=0)
+        first = next(iter(dl))[1].tolist()
+        second = next(iter(dl))[1].tolist()
+        assert first != second  # epoch advanced → different order
+
+
+class TestRepeatingLoader:
+    def test_wraps(self):
+        dl = DeepSpeedDataLoader(make_ds(16), batch_size=8,
+                                 data_parallel_world_size=1, data_parallel_rank=0)
+        rl = RepeatingLoader(dl)
+        got = [next(rl) for _ in range(5)]
+        assert len(got) == 5
+
+
+class TestCollate:
+    def test_tuple(self):
+        out = default_collate([(np.ones(2), 1), (np.zeros(2), 2)])
+        assert out[0].shape == (2, 2)
+        assert out[1].tolist() == [1, 2]
+
+    def test_dict(self):
+        out = default_collate([{"a": np.ones(3)}, {"a": np.zeros(3)}])
+        assert out["a"].shape == (2, 3)

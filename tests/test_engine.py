@@ -1,0 +1,284 @@
+"""Core engine tests — parity with reference tests/unit/test_fp16.py (the
+optimizer × precision × zero-stage matrix on SimpleModel) and
+test_dynamic_loss_scale.py (NaN injection → scale halving, overflow skip)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from simple_model import (simple_model_params, simple_loss_fn, random_dataset,
+                          random_batch, base_config)
+
+
+def make_engine(config, seed=0, **kw):
+    params = simple_model_params(jax.random.PRNGKey(seed))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=simple_loss_fn, model_params=params, config=config, **kw)
+    return engine
+
+
+class TestTrainBatch:
+    def test_loss_decreases(self):
+        engine = make_engine(base_config())
+        batch = random_batch(n=16)
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(20)]
+        assert losses[-1] < losses[0] * 0.8, losses
+
+    def test_counters(self):
+        engine = make_engine(base_config(gradient_accumulation_steps=2,
+                                         train_batch_size=32))
+        batch = random_batch(n=32)
+        engine.train_batch(batch=batch)
+        assert engine.global_steps == 1
+        assert engine.micro_steps == 2
+        assert engine.global_samples == 32
+        assert int(jax.device_get(engine.state.step)) == 1
+
+    def test_grad_accum_equivalence(self):
+        """gas=2 over batch B must equal gas=1 over the same batch B."""
+        b = random_batch(n=32, seed=3)
+        e1 = make_engine(base_config(train_batch_size=32,
+                                     gradient_accumulation_steps=1), seed=7)
+        e2 = make_engine(base_config(train_batch_size=32,
+                                     gradient_accumulation_steps=2), seed=7)
+        e1.train_batch(batch=b)
+        e2.train_batch(batch=b)
+        p1 = jax.device_get(e1.state.params)
+        p2 = jax.device_get(e2.state.params)
+        for k in p1:
+            np.testing.assert_allclose(p1[k], p2[k], rtol=2e-5, atol=2e-6)
+
+    def test_dataloader_driven(self):
+        ds = random_dataset(n=64)
+        engine = make_engine(base_config(train_batch_size=16), training_data=ds)
+        l0 = float(engine.train_batch())
+        for _ in range(10):
+            loss = engine.train_batch()
+        assert float(loss) < l0
+
+    def test_scheduler_advances_lr(self):
+        cfg = base_config()
+        cfg["scheduler"] = {"type": "WarmupLR",
+                            "params": {"warmup_min_lr": 0.0, "warmup_max_lr": 0.01,
+                                       "warmup_num_steps": 100}}
+        engine = make_engine(cfg)
+        batch = random_batch()
+        engine.train_batch(batch=batch)
+        lr_early = engine.get_lr()[0]
+        for _ in range(20):
+            engine.train_batch(batch=batch)
+        assert engine.get_lr()[0] > lr_early
+
+
+class TestPrecision:
+    def test_bf16(self):
+        engine = make_engine(base_config(bf16={"enabled": True}))
+        batch = random_batch()
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(15)]
+        assert losses[-1] < losses[0]
+        # master weights stay fp32
+        assert jax.device_get(engine.state.params)["w1"].dtype == np.float32
+
+    def test_fp16_trains(self):
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "initial_scale_power": 8}))
+        batch = random_batch()
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(15)]
+        assert losses[-1] < losses[0]
+
+    def test_fp16_overflow_skips_step(self):
+        """NaN injection parity with test_dynamic_loss_scale.py."""
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "initial_scale_power": 8, "hysteresis": 1}))
+        x, y = random_batch()
+        before = jax.device_get(engine.state.params)
+        scale_before = engine.loss_scale()
+        bad = (np.full_like(x, np.nan), y)
+        engine.train_batch(batch=bad)
+        after = jax.device_get(engine.state.params)
+        for k in before:
+            np.testing.assert_array_equal(before[k], after[k])
+        assert engine.loss_scale() == scale_before / 2
+        assert int(jax.device_get(engine.state.skipped_steps)) == 1
+        assert int(jax.device_get(engine.state.step)) == 0
+
+    def test_fp16_hysteresis(self):
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "initial_scale_power": 8, "hysteresis": 2}))
+        x, y = random_batch()
+        bad = (np.full_like(x, np.nan), y)
+        s0 = engine.loss_scale()
+        engine.train_batch(batch=bad)   # consumes hysteresis credit
+        assert engine.loss_scale() == s0
+        engine.train_batch(batch=bad)   # now halves
+        assert engine.loss_scale() == s0 / 2
+
+    def test_fp16_scale_growth(self):
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "initial_scale_power": 4,
+                  "loss_scale_window": 4}))
+        batch = random_batch()
+        s0 = engine.loss_scale()
+        for _ in range(4):
+            engine.train_batch(batch=batch)
+        assert engine.loss_scale() == s0 * 2
+
+    def test_static_loss_scale(self):
+        engine = make_engine(base_config(
+            fp16={"enabled": True, "loss_scale": 128}))
+        batch = random_batch()
+        engine.train_batch(batch=batch)
+        assert engine.loss_scale() == 128
+
+
+class TestZero:
+    @pytest.mark.parametrize("stage", [0, 1, 2])
+    def test_zero_matches_stage0(self, stage):
+        """Loss-curve parity across ZeRO stages (reference test style)."""
+        batch = random_batch(n=16, seed=5)
+        ref = make_engine(base_config(), seed=11)
+        z = make_engine(base_config(zero_optimization={"stage": stage}), seed=11)
+        for _ in range(5):
+            lr_ = ref.train_batch(batch=batch)
+            lz = z.train_batch(batch=batch)
+        np.testing.assert_allclose(float(lr_), float(lz), rtol=1e-4)
+        pr = jax.device_get(ref.state.params)
+        pz = jax.device_get(z.state.params)
+        for k in pr:
+            np.testing.assert_allclose(pr[k], pz[k], rtol=1e-4, atol=1e-6)
+
+    def test_zero_opt_state_sharded(self):
+        engine = make_engine(base_config(zero_optimization={"stage": 1}))
+        # at least one moment leaf sharded over the data axis
+        shardings = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: x.sharding, engine.state.opt_state))
+        assert any("data" in str(s.spec) for s in shardings
+                   if hasattr(s, "spec")), shardings
+
+    def test_stage3_rejected(self):
+        with pytest.raises(NotImplementedError):
+            make_engine(base_config(zero_optimization={"stage": 3}))
+
+
+class TestOptimizers:
+    @pytest.mark.parametrize("name", ["Adam", "AdamW", "Lamb", "SGD"])
+    def test_optimizer_matrix(self, name):
+        cfg = base_config()
+        cfg["optimizer"] = {"type": name, "params": {"lr": 1e-2}}
+        engine = make_engine(cfg)
+        batch = random_batch()
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+    def test_client_optimizer(self):
+        import optax
+        params = simple_model_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_params=params,
+            optimizer=optax.sgd(1e-2), config=base_config())
+        batch = random_batch()
+        losses = [float(engine.train_batch(batch=batch)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+
+    def test_gradient_clipping(self):
+        # SGD: update magnitude is proportional to the clipped grad norm
+        # (Adam would renormalize, hiding the clip).
+        cfg = base_config(gradient_clipping=1e-6)
+        cfg["optimizer"] = {"type": "SGD", "params": {"lr": 1.0}}
+        engine = make_engine(cfg)
+        batch = random_batch()
+        before = jax.device_get(engine.state.params)["w1"]
+        engine.train_batch(batch=batch)
+        after = jax.device_get(engine.state.params)["w1"]
+        assert np.abs(after - before).max() < 1e-5
+
+
+class TestCompatibilityTrio:
+    def test_forward_backward_step(self):
+        engine = make_engine(base_config(train_batch_size=16,
+                                         gradient_accumulation_steps=2))
+        x, y = random_batch(n=16)
+        halves = [(x[:8], y[:8]), (x[8:], y[8:])]
+        l0 = None
+        for _ in range(10):
+            for mb in halves:
+                loss = engine.forward(mb)
+                engine.backward(loss)
+                engine.step()
+            if l0 is None:
+                l0 = float(loss)
+        assert engine.global_steps == 10
+        assert float(loss) < l0
+
+    def test_boundary_gating(self):
+        engine = make_engine(base_config(train_batch_size=16,
+                                         gradient_accumulation_steps=2))
+        mb = random_batch(n=8)
+        engine.forward(mb)
+        engine.backward(None)
+        engine.step()  # not at boundary: no-op
+        assert engine.global_steps == 0
+        engine.forward(mb)
+        engine.backward(None)
+        engine.step()
+        assert engine.global_steps == 1
+
+
+class TestCheckpoint:
+    def test_round_trip(self, tmp_path):
+        engine = make_engine(base_config())
+        batch = random_batch()
+        for _ in range(3):
+            engine.train_batch(batch=batch)
+        engine.save_checkpoint(str(tmp_path), client_state={"foo": 7})
+        p_saved = jax.device_get(engine.state.params)
+
+        # diverge, then restore
+        for _ in range(3):
+            engine.train_batch(batch=batch)
+        path, client = engine.load_checkpoint(str(tmp_path))
+        assert path is not None
+        assert client["foo"] == 7
+        assert engine.global_steps == 3
+        p_loaded = jax.device_get(engine.state.params)
+        for k in p_saved:
+            np.testing.assert_array_equal(p_saved[k], p_loaded[k])
+
+    def test_latest_pointer(self, tmp_path):
+        engine = make_engine(base_config())
+        batch = random_batch()
+        engine.train_batch(batch=batch)
+        engine.save_checkpoint(str(tmp_path), tag="tagA")
+        engine.train_batch(batch=batch)
+        engine.save_checkpoint(str(tmp_path), tag="tagB")
+        assert (tmp_path / "latest").read_text() == "tagB"
+
+    def test_fresh_engine_resume(self, tmp_path):
+        cfg = base_config()
+        e1 = make_engine(cfg, seed=0)
+        batch = random_batch()
+        for _ in range(5):
+            e1.train_batch(batch=batch)
+        e1.save_checkpoint(str(tmp_path))
+        # brand-new engine, different init seed; loads into same state
+        e2 = make_engine(cfg, seed=99)
+        e2.load_checkpoint(str(tmp_path))
+        l1 = float(e1.train_batch(batch=batch))
+        l2 = float(e2.train_batch(batch=batch))
+        assert l1 == pytest.approx(l2, rel=1e-5)
+
+    def test_missing_checkpoint(self, tmp_path):
+        engine = make_engine(base_config())
+        path, client = engine.load_checkpoint(str(tmp_path))
+        assert path is None
+
+
+class TestEval:
+    def test_eval_batch(self):
+        engine = make_engine(base_config())
+        batch = random_batch()
+        loss = engine.eval_batch(batch)
+        assert np.isfinite(float(loss))
+        # eval does not advance counters
+        assert engine.global_steps == 0
