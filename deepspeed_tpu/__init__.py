@@ -19,7 +19,7 @@ from .utils.logging import logger, log_dist
 def initialize(args=None, model=None, optimizer=None, model_params=None,
                training_data=None, lr_scheduler=None, mpu=None,
                dist_init_required=None, collate_fn=None, config=None,
-               config_params=None, rng=None):
+               config_params=None, rng=None, param_shardings=None, mesh=None):
     """Initialize the engine. Parity with reference ``__init__.py:50``.
 
     Returns a tuple of ``(engine, optimizer, dataloader, lr_scheduler)``.
@@ -46,7 +46,8 @@ def initialize(args=None, model=None, optimizer=None, model_params=None,
                                  model_params=model_params, training_data=training_data,
                                  lr_scheduler=lr_scheduler, mpu=mpu,
                                  dist_init_required=dist_init_required,
-                                 collate_fn=collate_fn, config=cfg, rng=rng)
+                                 collate_fn=collate_fn, config=cfg, rng=rng,
+                                 param_shardings=param_shardings, mesh=mesh)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

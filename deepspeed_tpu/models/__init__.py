@@ -1,0 +1,21 @@
+"""Model families shipped with the framework.
+
+The reference ships fused BERT kernels (csrc/transformer/) and drives GPT-2 /
+BERT through external example repos (tests/model/Megatron_GPT2, BingBertSquad).
+Here the models are first-class: pure-functional JAX transformers with
+mesh-axis sharding specs (Megatron-style TP), scan-over-layers compilation,
+and remat policies standing in for the reference's memory knobs.
+"""
+from .transformer import TransformerConfig, layer_norm, dense
+from .gpt2 import (GPT2Config, gpt2_init, gpt2_apply, gpt2_loss_fn,
+                   gpt2_param_shardings, GPT2_CONFIGS)
+from .bert import (BertConfig, bert_init, bert_apply, bert_mlm_loss_fn,
+                   bert_param_shardings, BERT_CONFIGS)
+
+__all__ = [
+    "TransformerConfig", "layer_norm", "dense",
+    "GPT2Config", "gpt2_init", "gpt2_apply", "gpt2_loss_fn",
+    "gpt2_param_shardings", "GPT2_CONFIGS",
+    "BertConfig", "bert_init", "bert_apply", "bert_mlm_loss_fn",
+    "bert_param_shardings", "BERT_CONFIGS",
+]

@@ -1,0 +1,132 @@
+"""BERT encoder family with MLM head.
+
+The reference's flagship kernel workload is BERT pretraining (fused encoder
+layer csrc/transformer/ds_transformer_cuda.cpp; numerical references in
+tests/unit/modeling.py, modelingpreln.py — post-LN and pre-LN variants).
+This module is both variants, driven by ``pre_layer_norm``: token + position
+(+ segment) embeddings → embedding LN → N blocks → MLM head over tied
+embeddings.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import (TransformerConfig, apply_blocks, block_param_shardings,
+                          dense, dense_attention, gelu, init_block_params,
+                          layer_norm)
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig(TransformerConfig):
+    causal: bool = False
+    pre_layer_norm: bool = False        # original BERT; preln variant = True
+    max_seq_length: int = 512
+    vocab_size: int = 30528             # bert-large vocab padded to 64
+    type_vocab_size: int = 2
+
+
+BERT_CONFIGS: Dict[str, BertConfig] = {
+    "bert-base":  BertConfig(hidden_size=768, num_heads=12, num_layers=12),
+    "bert-large": BertConfig(hidden_size=1024, num_heads=16, num_layers=24),
+    "bert-large-preln": BertConfig(hidden_size=1024, num_heads=16,
+                                   num_layers=24, pre_layer_norm=True),
+    "bert-tiny":  BertConfig(hidden_size=128, num_heads=4, num_layers=2,
+                             max_seq_length=128, vocab_size=512),
+}
+
+
+def bert_init(rng: jax.Array, cfg: BertConfig) -> Dict[str, Any]:
+    ks = jax.random.split(rng, 5)
+    std = cfg.initializer_range
+    H = cfg.hidden_size
+    params = {
+        "wte": jax.random.normal(ks[0], (cfg.vocab_size, H), jnp.float32) * std,
+        "wpe": jax.random.normal(ks[1], (cfg.max_seq_length, H), jnp.float32) * std,
+        "emb_ln_scale": jnp.ones((H,), jnp.float32),
+        "emb_ln_bias": jnp.zeros((H,), jnp.float32),
+        "blocks": init_block_params(ks[2], cfg),
+        # MLM head: dense + LN + tied-embedding decoder bias.
+        "mlm_kernel": jax.random.normal(ks[3], (H, H), jnp.float32) * std,
+        "mlm_bias": jnp.zeros((H,), jnp.float32),
+        "mlm_ln_scale": jnp.ones((H,), jnp.float32),
+        "mlm_ln_bias": jnp.zeros((H,), jnp.float32),
+        "decoder_bias": jnp.zeros((cfg.vocab_size,), jnp.float32),
+    }
+    if cfg.type_vocab_size:
+        params["wse"] = jax.random.normal(
+            ks[4], (cfg.type_vocab_size, H), jnp.float32) * std
+    return params
+
+
+def bert_param_shardings(cfg: BertConfig, mp_axis: str = "model") -> Dict[str, Any]:
+    sh = {
+        "wte": P(mp_axis, None),
+        "wpe": P(None, None),
+        "emb_ln_scale": P(None), "emb_ln_bias": P(None),
+        "blocks": block_param_shardings(mp_axis),
+        "mlm_kernel": P(None, None), "mlm_bias": P(None),
+        "mlm_ln_scale": P(None), "mlm_ln_bias": P(None),
+        "decoder_bias": P(mp_axis),
+    }
+    if cfg.type_vocab_size:
+        sh["wse"] = P(None, None)
+    return sh
+
+
+def bert_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: BertConfig,
+               segment_ids: Optional[jnp.ndarray] = None,
+               attention_mask: Optional[jnp.ndarray] = None,
+               rng: Optional[jax.Array] = None, deterministic: bool = True,
+               attention_fn=None) -> jnp.ndarray:
+    """tokens [B, S] → final hidden states [B, S, H].
+
+    ``attention_mask`` [B, S] with 1 = attend: converted to the additive
+    [B, 1, 1, S] form (the reference's fused softmax consumes the same,
+    transformer.py:208-216).
+    """
+    B, S = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[None, :S]
+    if cfg.type_vocab_size and segment_ids is not None:
+        x = x + params["wse"].astype(cfg.dtype)[segment_ids]
+    x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
+                   cfg.layer_norm_eps)
+    add_mask = None
+    if attention_mask is not None:
+        add_mask = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) \
+            * -1e9
+    return apply_blocks(params["blocks"], x, cfg, mask=add_mask, rng=rng,
+                        deterministic=deterministic, attention_fn=attention_fn)
+
+
+def bert_mlm_logits(params: Dict[str, Any], hidden: jnp.ndarray,
+                    cfg: BertConfig) -> jnp.ndarray:
+    h = gelu(dense(hidden, params["mlm_kernel"], params["mlm_bias"]))
+    h = layer_norm(h, params["mlm_ln_scale"], params["mlm_ln_bias"],
+                   cfg.layer_norm_eps)
+    return h @ params["wte"].astype(h.dtype).T + \
+        params["decoder_bias"].astype(h.dtype)
+
+
+def bert_mlm_loss_fn(cfg: BertConfig, attention_fn=None):
+    """loss_fn(params, batch, rng); batch = (tokens, labels[, attention_mask])
+    with labels == -100 at unmasked positions (HF convention)."""
+    def loss_fn(params, batch, rng):
+        tokens, labels = batch[0], batch[1]
+        attn_mask = batch[2] if len(batch) > 2 else None
+        hidden = bert_apply(params, tokens, cfg, attention_mask=attn_mask,
+                            rng=rng, deterministic=False,
+                            attention_fn=attention_fn)
+        logits = bert_mlm_logits(params, hidden, cfg).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = labels >= 0
+        safe_labels = jnp.where(valid, labels, 0)
+        nll = -jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+        denom = jnp.maximum(jnp.sum(valid), 1)
+        return jnp.sum(jnp.where(valid, nll, 0.0)) / denom
+    return loss_fn

@@ -1,0 +1,127 @@
+"""GPT-2 causal language model family.
+
+The reference trains GPT-2 through Megatron-LM examples
+(tests/model/Megatron_GPT2/, docs/_tutorials/megatron.md); here it is a
+built-in model: token+position embeddings → N pre-LN blocks → final LN →
+tied-embedding logits → next-token cross-entropy. Sizes cover the benchmark
+ladder in BASELINE.json (small → 1.5B).
+
+Sharding story (Megatron TP via GSPMD): block kernels column/row-sharded on
+the "model" axis (transformer.block_param_shardings); the token embedding is
+vocab-sharded so the tied logits matmul is column-parallel and the CE loss
+reduces over the sharded vocab axis with an XLA-inserted all-reduce.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .transformer import (TransformerConfig, apply_blocks, block_param_shardings,
+                          count_params, dense_attention, init_block_params,
+                          layer_norm)
+
+
+@dataclasses.dataclass(frozen=True)
+class GPT2Config(TransformerConfig):
+    causal: bool = True
+    pre_layer_norm: bool = True
+    max_seq_length: int = 1024
+    vocab_size: int = 50304            # padded to a multiple of 128 for MXU tiling
+
+    @property
+    def name(self) -> str:
+        return f"gpt2-h{self.hidden_size}-l{self.num_layers}"
+
+
+GPT2_CONFIGS: Dict[str, GPT2Config] = {
+    # Benchmark ladder (BASELINE.json configs).
+    "gpt2-small":  GPT2Config(hidden_size=768,  num_heads=12, num_layers=12),
+    "gpt2-medium": GPT2Config(hidden_size=1024, num_heads=16, num_layers=24),
+    "gpt2-large":  GPT2Config(hidden_size=1280, num_heads=20, num_layers=36),
+    "gpt2-xl":     GPT2Config(hidden_size=1600, num_heads=25, num_layers=48),  # 1.5B
+    "gpt2-tiny":   GPT2Config(hidden_size=128,  num_heads=4,  num_layers=2,
+                              max_seq_length=128, vocab_size=512),  # tests
+}
+
+
+def gpt2_init(rng: jax.Array, cfg: GPT2Config) -> Dict[str, Any]:
+    k_emb, k_pos, k_blocks = jax.random.split(rng, 3)
+    std = cfg.initializer_range
+    return {
+        "wte": jax.random.normal(k_emb, (cfg.vocab_size, cfg.hidden_size),
+                                 jnp.float32) * std,
+        "wpe": jax.random.normal(k_pos, (cfg.max_seq_length, cfg.hidden_size),
+                                 jnp.float32) * std,
+        "blocks": init_block_params(k_blocks, cfg),
+        "ln_f_scale": jnp.ones((cfg.hidden_size,), jnp.float32),
+        "ln_f_bias": jnp.zeros((cfg.hidden_size,), jnp.float32),
+    }
+
+
+def gpt2_param_shardings(cfg: GPT2Config, mp_axis: str = "model") -> Dict[str, Any]:
+    """PartitionSpec tree matching gpt2_init's structure."""
+    return {
+        "wte": P(mp_axis, None),          # vocab-sharded (column-parallel logits)
+        "wpe": P(None, None),
+        "blocks": block_param_shardings(mp_axis),
+        "ln_f_scale": P(None),
+        "ln_f_bias": P(None),
+    }
+
+
+def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
+               rng: Optional[jax.Array] = None, deterministic: bool = True,
+               attention_fn=None) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    B, S = tokens.shape
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[None, :S]
+    x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
+                     deterministic=deterministic, attention_fn=attention_fn)
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                   cfg.layer_norm_eps)
+    # Tied unembedding (the reference ties via TiedLayerSpec in pipeline
+    # models; here it is structural).
+    logits = x @ params["wte"].astype(cfg.dtype).T
+    return logits
+
+
+def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
+    """Returns loss_fn(params, batch, rng) for the engine.
+
+    batch: tokens [B, S+1] (inputs are [:, :-1], targets [:, 1:]) or a
+    (tokens, targets) tuple.
+    """
+    def loss_fn(params, batch, rng):
+        if isinstance(batch, (tuple, list)):
+            tokens, targets = batch[0], batch[1]
+        else:
+            tokens, targets = batch[:, :-1], batch[:, 1:]
+        logits = gpt2_apply(params, tokens, cfg, rng=rng, deterministic=False,
+                            attention_fn=attention_fn)
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+    return loss_fn
+
+
+def gpt2_num_params(cfg: GPT2Config) -> int:
+    H, L, F, V, S = (cfg.hidden_size, cfg.num_layers, cfg.ffn_size,
+                     cfg.vocab_size, cfg.max_seq_length)
+    per_block = 4 * H + 3 * H * H + 3 * H + H * H + H + 2 * H * F + F + H
+    return V * H + S * H + L * per_block + 2 * H
+
+
+def gpt2_flops_per_token(cfg: GPT2Config, seq_len: Optional[int] = None) -> float:
+    """Training FLOPs/token ≈ 6·N_nonemb + attention term (PaLM appendix B
+    counting)."""
+    S = seq_len or cfg.max_seq_length
+    H, L = cfg.hidden_size, cfg.num_layers
+    n = gpt2_num_params(cfg) - cfg.vocab_size * H - cfg.max_seq_length * H
+    return 6.0 * n + 12.0 * L * H * S

@@ -1,0 +1,342 @@
+"""Flash attention — Pallas TPU kernels with custom VJP.
+
+The TPU-native replacement for the reference's fused attention core inside
+the transformer kernel (csrc/transformer/softmax_kernels.cu +
+strided_batch_gemm.h: QK^T → scale+mask softmax → AV, with saved softmax
+output replayed in backward). On TPU the dense [S,S] fp32 score tensor is
+the HBM bottleneck, so we never materialize it: the classic flash pattern
+computes attention block-by-block in VMEM with a running (max, sum)
+softmax, and the backward recomputes scores per block from the saved
+logsumexp — the same memory story as the reference's
+``attn_dropout_checkpoint`` knob taken to its limit.
+
+Layout: kernels run over [BH, S, D] (batch×heads flattened, head_dim last).
+Grid is (BH, q_blocks, k_blocks); the innermost (k) dimension iterates
+sequentially on TPU so VMEM scratch carries the running softmax state
+across k-blocks of one q-block. Causal skips fully-masked k-blocks.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend bits are importable everywhere; interpret=True runs on CPU
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(s: int, target: int = 512) -> int:
+    for b in (target, 256, 128):
+        if s % b == 0:
+            return b
+    return s  # small sequences: single block
+
+
+# --------------------------------------------------------------------- #
+# Forward kernel
+# --------------------------------------------------------------------- #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, scale: float, causal: bool, bq: int, bk: int):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # Causal: skip k-blocks strictly above the diagonal band.
+    run = True
+    if causal:
+        run = kj * bk < (qi + 1) * bq
+
+    @pl.when(run)
+    def _compute():
+        q = q_ref[0]                       # [BQ, D]
+        k = k_ref[0]                       # [BK, D]
+        v = v_ref[0]                       # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [BQ, BK]
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+
+        m_prev = m_scr[:, 0:1]                            # [BQ, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                   # [BQ, 1]
+        p = jnp.exp(s - m_new)                            # [BQ, BK]
+        l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, D]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+
+
+def _flash_fwd(q, k, v, scale: float, causal: bool):
+    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S] f32)."""
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _pick_block(S), _pick_block(Sk)
+    grid = (BH, S // bq, Sk // bk)
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return o, lse
+
+
+# --------------------------------------------------------------------- #
+# Backward kernels
+# --------------------------------------------------------------------- #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   acc_scr, *, scale: float, causal: bool, bq: int, bk: int):
+    qi, kj = pl.program_id(1), pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    run = True
+    if causal:
+        run = kj * bk < (qi + 1) * bq
+
+    @pl.when(run)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]                                    # [BQ, D]
+        lse = lse_ref[0, 0][:, None]                      # [BQ, 1]
+        delta = delta_ref[0, 0][:, None]                  # [BQ, 1]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
+            s = jnp.where(rows >= cols, s, NEG_INF)
+        p = jnp.exp(s - lse)                              # softmax [BQ, BK]
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BQ, BK]
+        ds = p * (dp - delta) * scale
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr,
+                    *, scale: float, causal: bool, bq: int, bk: int):
+    kj, qi = pl.program_id(1), pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    run = True
+    if causal:
+        run = kj * bk < (qi + 1) * bq
+
+    @pl.when(run)
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0, 0][None, :]                      # [1, BQ]
+        delta = delta_ref[0, 0][None, :]                  # [1, BQ]
+        # s2[i, j] = k_i · q_j (transposed score block)
+        s2 = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale   # [BK, BQ]
+        if causal:
+            krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + kj * bk
+            qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qi * bq
+            s2 = jnp.where(qcols >= krows, s2, NEG_INF)
+        p2 = jnp.exp(s2 - lse)                            # [BK, BQ] = p.T
+        dv_scr[:] += jax.lax.dot_general(
+            p2.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp2 = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)           # [BK, BQ] = dp.T
+        ds2 = p2 * (dp2 - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds2.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool):
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    bq, bk = _pick_block(S), _pick_block(Sk)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH, 1, S]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(BH, S // bq, Sk // bk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk),
+        grid=(BH, Sk // bk, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, Sk, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------------- #
+# custom_vjp wrapper
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash(q, k, v, scale: float, causal: bool):
+    o, _ = _flash_fwd(q, k, v, scale, causal)
+    return o
+
+
+def _flash_vjp_fwd(q, k, v, scale, causal):
+    o, lse = _flash_fwd(q, k, v, scale, causal)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, res, do):
+    q, k, v, o, lse = res
+    return _flash_bwd(q, k, v, o, lse, do, scale, causal)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                    mask: Optional[jnp.ndarray] = None, causal: bool = False,
+                    attn_dropout: float = 0.0, rng=None,
+                    deterministic: bool = True) -> jnp.ndarray:
+    """Drop-in for models.transformer.dense_attention: q,k,v [B,S,nH,dH].
+
+    Falls back to the dense path for additive masks or attention dropout
+    (the reference keeps a non-fused path for the same cases,
+    transformer.py:153 vs the vanilla BertSelfAttention it replaces).
+    """
+    if mask is not None or (attn_dropout > 0.0 and not deterministic):
+        from ..models.transformer import dense_attention
+        return dense_attention(q, k, v, mask=mask, causal=causal,
+                               attn_dropout=attn_dropout, rng=rng,
+                               deterministic=deterministic)
+    B, S, nH, D = q.shape
+    if S % 128 != 0:
+        from ..models.transformer import dense_attention
+        return dense_attention(q, k, v, mask=mask, causal=causal,
+                               attn_dropout=attn_dropout, rng=rng,
+                               deterministic=deterministic)
+    scale = 1.0 / math.sqrt(D)
+    qt = q.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
+    kt = k.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
+    vt = v.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
+    o = _flash(qt, kt, vt, scale, causal)
+    return o.reshape(B, nH, S, D).transpose(0, 2, 1, 3)
+
+
+def auto_attention(q, k, v, mask=None, causal=False, attn_dropout=0.0,
+                   rng=None, deterministic=True):
+    """Best attention for the current backend: flash kernels on TPU, plain
+    XLA dense elsewhere (Pallas interpret mode is for correctness tests,
+    not speed)."""
+    if jax.default_backend() == "tpu":
+        return flash_attention(q, k, v, mask=mask, causal=causal,
+                               attn_dropout=attn_dropout, rng=rng,
+                               deterministic=deterministic)
+    from ..models.transformer import dense_attention
+    return dense_attention(q, k, v, mask=mask, causal=causal,
+                           attn_dropout=attn_dropout, rng=rng,
+                           deterministic=deterministic)

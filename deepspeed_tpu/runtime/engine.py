@@ -118,7 +118,8 @@ class DeepSpeedEngine:
                  training_data=None, lr_scheduler=None, mpu=None,
                  dist_init_required=None, collate_fn=None,
                  config: Union[str, Dict[str, Any], None] = None, rng=None,
-                 mesh: Optional[Mesh] = None, dont_change_device: bool = False):
+                 mesh: Optional[Mesh] = None, dont_change_device: bool = False,
+                 param_shardings=None):
         if dist_init_required is None or dist_init_required:
             comm.init_distributed()
 
@@ -179,7 +180,9 @@ class DeepSpeedEngine:
             skipped_steps=jnp.asarray(0, jnp.int32),
         )
 
-        # Shardings: params replicated; opt state ZeRO-sharded over dp.
+        # Shardings: params per TP spec (replicated by default); opt state
+        # ZeRO-sharded over dp, composed with the TP spec.
+        self._param_specs = param_shardings
         self._state_shardings = self._make_state_shardings()
         self.state = self._place_state(self.state)
 
@@ -209,7 +212,8 @@ class DeepSpeedEngine:
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_size,
-            start_step=2, steps_per_output=self.steps_per_print())
+            start_step=2, steps_per_output=self.steps_per_print(),
+            synchronized=self.wall_clock_breakdown())
         self._monitor = _Monitor(self.config)
 
         # Grad buffer for the forward/backward/step compatibility API.
@@ -301,13 +305,26 @@ class DeepSpeedEngine:
                     min_scale=1.0, hysteresis=2)
 
     def _make_state_shardings(self) -> EngineState:
-        """Replicated params; ZeRO stage >= 1 shards optimizer state over dp."""
+        """Params per TP spec (default replicated); ZeRO stage >= 1 shards
+        optimizer state over dp, layered on top of the TP spec."""
         def repl(tree):
             return jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), tree)
-        params_sh = repl(self.state.params)
+        if self._param_specs is not None:
+            params_sh = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                self._param_specs, is_leaf=lambda x: isinstance(x, P))
+        else:
+            params_sh = repl(self.state.params)
         if self.zero_optimization_stage() >= 1 and self.dp_size > 1:
-            opt_sh = zero_shardings(self.state.opt_state, self.mesh, DP_AXIS)
+            opt_sh = zero_shardings(self.state.opt_state, self.mesh, DP_AXIS,
+                                    params=self.state.params,
+                                    param_specs=self._param_specs)
+        elif self._param_specs is not None:
+            # Moments follow the param TP layout; no ZeRO axis.
+            opt_sh = zero_shardings(self.state.opt_state, self.mesh, None,
+                                    params=self.state.params,
+                                    param_specs=self._param_specs)
         else:
             opt_sh = repl(self.state.opt_state)
         scalar = NamedSharding(self.mesh, P())
@@ -430,6 +447,9 @@ class DeepSpeedEngine:
         grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 
         def train_step(state: EngineState, micro_batches, rng):
+            # Derive the per-step key INSIDE jit (a host-side fold_in would
+            # dispatch eager device ops every step).
+            rng = jax.random.fold_in(rng, state.step)
             scale = state.loss_scale
 
             def accum(carry, xs):
@@ -518,15 +538,18 @@ class DeepSpeedEngine:
         return jax.random.fold_in(self._base_rng, self.global_steps)
 
     def _stack_micro_batches(self, batch):
-        """Host-side reshape to [gas, per_micro_step, ...]."""
+        """Reshape to [gas, per_micro_step, ...]. Device arrays stay on
+        device (np.asarray on a jax.Array would be a synchronous D2H
+        round-trip every step — ruinous over a tunneled backend)."""
         gas = self.gradient_accumulation_steps()
 
         def reshape(x):
-            x = np.asarray(x) if not isinstance(x, (jax.Array, np.ndarray)) else x
+            if not isinstance(x, (jax.Array, np.ndarray)):
+                x = np.asarray(x)
             lead = x.shape[0]
             assert lead % gas == 0, \
                 f"batch dim {lead} not divisible by grad-accum {gas}"
-            return np.asarray(x).reshape((gas, lead // gas) + x.shape[1:])
+            return x.reshape((gas, lead // gas) + x.shape[1:])
         return jax.tree_util.tree_map(reshape, batch)
 
     def train_batch(self, batch=None, data_iter=None):
@@ -555,9 +578,23 @@ class DeepSpeedEngine:
                 *micro)
 
         micro_batches = self._stack_micro_batches(batch)
+        if self.dp_size > 1:
+            # Shard the per-micro-step batch dim over dp so XLA partitions
+            # the whole forward/backward data-parallel. Multi-process: each
+            # process holds only its local dp share, so assemble the global
+            # array from per-process shards instead of device_put (which
+            # would treat every local array as the full global batch).
+            shardings = self._batch_sharding(micro_batches, leading_dims=2)
+            if jax.process_count() > 1:
+                micro_batches = jax.tree_util.tree_map(
+                    lambda x, sh: jax.make_array_from_process_local_data(
+                        sh, np.asarray(x)),
+                    micro_batches, shardings)
+            else:
+                micro_batches = jax.device_put(micro_batches, shardings)
         self.tput_timer.start()
         self.state, metrics = self._train_step_fn(
-            self.state, micro_batches, self._next_rng())
+            self.state, micro_batches, self._base_rng)
 
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
@@ -580,17 +617,20 @@ class DeepSpeedEngine:
         return self._eval_step_fn(self.state.params, batch, rng)
 
     def _maybe_log(self, metrics) -> None:
+        """Log at steps_per_print boundaries ONLY — any device_get here is a
+        host↔device sync that would stall the async dispatch pipeline (the
+        TPU analogue of the reference keeping cuda.synchronize behind
+        wall_clock_breakdown). skipped_steps syncs lazily from state."""
         if self.global_steps % max(1, self.steps_per_print()) == 0:
             m = {k: (float(jax.device_get(v)) if hasattr(v, "dtype") else v)
                  for k, v in metrics.items()}
+            self.skipped_steps = int(jax.device_get(self.state.skipped_steps))
             log_dist(
                 f"step={self.global_steps} loss={m['loss']:.6f} "
                 f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.4f} "
                 f"loss_scale={m['loss_scale']:.1f} overflow={bool(m['overflow'])}",
                 ranks=[0])
             self._monitor.write(self.global_steps, m)
-        if bool(jax.device_get(metrics["overflow"])):
-            self.skipped_steps += 1
 
     # ------------------------------------------------------------------ #
     # torch-style compatibility trio (forward → backward → step)
@@ -715,6 +755,8 @@ class DeepSpeedEngine:
         os.makedirs(path, exist_ok=True)
 
         host_state = jax.device_get(self.state)
+        # Host counter may lag the device value between log boundaries.
+        self.skipped_steps = int(host_state.skipped_steps)
         model_blob = {
             "module": jax.tree_util.tree_map(np.asarray, host_state.params),
         }

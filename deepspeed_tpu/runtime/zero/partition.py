@@ -12,7 +12,7 @@ streams.
 """
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -32,16 +32,76 @@ def _leaf_spec(shape, axis_size: int, axis_name: str) -> P:
     return P()
 
 
-def zero_shardings(opt_state: Any, mesh: Mesh, axis_name: str) -> Any:
-    """NamedShardings for an optax state pytree, ZeRO-partitioned over dp."""
-    axis_size = mesh.shape[axis_name]
+def _layer_dp(base: P, shape, axis_size: int, axis_name: str) -> P:
+    """Add the dp axis onto the first unsharded divisible dim of ``base``."""
+    parts = list(base) + [None] * (len(shape) - len(base))
+    for i, d in enumerate(shape):
+        if parts[i] is None and d >= axis_size and d % axis_size == 0:
+            parts[i] = axis_name
+            break
+    return P(*parts)
 
-    def spec(leaf):
-        if hasattr(leaf, "shape") and getattr(leaf, "ndim", 0) >= 1:
-            return NamedSharding(mesh, _leaf_spec(leaf.shape, axis_size, axis_name))
-        return NamedSharding(mesh, P())
 
-    return jax.tree_util.tree_map(spec, opt_state)
+def base_spec_leaves(opt_state: Any, params: Any, param_specs: Any):
+    """Per-leaf base (TP) PartitionSpecs for an optimizer-state pytree.
+
+    Optimizer moments mirror the param tree *structurally* (optax states
+    nest copies of the param pytree), so subtrees whose treedef equals the
+    param treedef inherit ``param_specs`` wholesale; all other leaves
+    (step counters etc.) are replicated. Structural matching avoids the
+    shape-collision trap of keying by array shape (two same-shaped params
+    with different specs).
+    """
+    p_def = jax.tree_util.tree_structure(params)
+
+    def params_like(node) -> bool:
+        try:
+            return jax.tree_util.tree_structure(node) == p_def
+        except Exception:
+            return False
+
+    base_tree = jax.tree_util.tree_map(
+        lambda node: param_specs if params_like(node) else P(),
+        opt_state, is_leaf=params_like)
+    # Flatten with P treated as a leaf (P is a tuple subclass, so a plain
+    # flatten would descend into it).
+    return jax.tree_util.tree_leaves(
+        base_tree, is_leaf=lambda x: isinstance(x, P))
+
+
+def zero_shardings(opt_state: Any, mesh: Mesh, axis_name: Optional[str],
+                   params: Any = None, param_specs: Any = None) -> Any:
+    """NamedShardings for an optax state pytree.
+
+    ``axis_name`` (usually the dp axis) is layered onto each leaf's first
+    still-unsharded divisible dimension — ZeRO partitioning. With tensor
+    parallelism, pass ``params`` + ``param_specs``: moments keep the TP
+    sharding and dp is layered on top — the reference's ZeRO-under-Megatron
+    configuration (stage2.py:162-167). ``axis_name=None`` applies only the
+    TP layout (no ZeRO).
+    """
+    axis_size = mesh.shape[axis_name] if axis_name else 1
+    leaves, treedef = jax.tree_util.tree_flatten(opt_state)
+
+    if params is not None and param_specs is not None:
+        bases = base_spec_leaves(opt_state, params, param_specs)
+    else:
+        bases = [None] * len(leaves)
+
+    out = []
+    for leaf, base in zip(leaves, bases):
+        if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
+            out.append(NamedSharding(mesh, P()))
+        elif base is not None:
+            spec = _layer_dp(base, leaf.shape, axis_size, axis_name) \
+                if axis_name else base
+            out.append(NamedSharding(mesh, spec))
+        elif axis_name:
+            out.append(NamedSharding(
+                mesh, _leaf_spec(leaf.shape, axis_size, axis_name)))
+        else:
+            out.append(NamedSharding(mesh, P()))
+    return jax.tree_util.tree_unflatten(treedef, out)
 
 
 def describe_sharding(opt_state: Any, shardings: Any) -> str:

@@ -99,13 +99,19 @@ class SynchronizedWallClockTimer:
 
 
 class ThroughputTimer:
-    """Samples/sec tracker with warm-up, parity with timer.py:106-183."""
+    """Samples/sec tracker with warm-up, parity with timer.py:106-183.
+
+    TPU-native delta: per-step device fences would serialize the async
+    dispatch pipeline (each fence is a full host↔device round trip — ruinous
+    on a tunneled backend), so by default the timer syncs only at reporting
+    windows and averages over the window. ``synchronized=True`` restores the
+    reference's fence-every-step behavior (wall_clock_breakdown).
+    """
 
     def __init__(self, batch_size: int, num_workers: int = 1, start_step: int = 2,
                  steps_per_output: Optional[int] = None, monitor_memory: bool = False,
-                 logging_fn=None):
+                 logging_fn=None, synchronized: bool = False):
         self.start_time = 0.0
-        self.end_time = 0.0
         self.started = False
         self.batch_size = max(1, batch_size)
         self.num_workers = num_workers
@@ -114,24 +120,32 @@ class ThroughputTimer:
         self.micro_step_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
+        self.counted_steps = 0
         self.steps_per_output = steps_per_output
         self.monitor_memory = monitor_memory
         self.logging = logging_fn or logger.info
-        self.initialized = False
+        self.synchronized = synchronized
+        # Windowed (non-synchronized) mode needs a window length to close
+        # measurements; default to 100 steps when no report cadence is set.
+        self._window_len = steps_per_output or 100
+        self._window_start: Optional[float] = None
+        self._window_steps = 0
 
     def update_epoch_count(self) -> None:
         self.epoch_count += 1
         self.micro_step_count = 0
 
-    def _init_timer(self) -> None:
-        self.initialized = True
-
     def start(self) -> None:
-        self._init_timer()
         self.started = True
-        if self.global_step_count >= self.start_step:
+        if self.global_step_count < self.start_step:
+            return
+        if self.synchronized:
             _device_sync()
             self.start_time = time.time()
+        elif self._window_start is None:
+            _device_sync()
+            self._window_start = time.time()
+            self._window_steps = 0
 
     def stop(self, report_speed: bool = True) -> None:
         if not self.started:
@@ -139,23 +153,38 @@ class ThroughputTimer:
         self.started = False
         self.micro_step_count += 1
         self.global_step_count += 1
-        if self.start_time > 0:
+        if self.global_step_count <= self.start_step:
+            return
+        if self.synchronized:
             _device_sync()
-            self.end_time = time.time()
-            duration = self.end_time - self.start_time
+            duration = time.time() - self.start_time
             self.total_elapsed_time += duration
-            if report_speed and self.steps_per_output and \
-                    self.global_step_count % self.steps_per_output == 0:
-                self.logging(
-                    f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                    f"global_step={self.global_step_count}, "
-                    f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
-                    f"CurrSamplesPerSec={self.batch_size * self.num_workers / duration:.4f}")
+            self.counted_steps += 1
+            self._maybe_report(report_speed, duration)
+        else:
+            self._window_steps += 1
+            boundary = self.global_step_count % self._window_len == 0
+            if boundary and self._window_start is not None:
+                _device_sync()
+                duration = time.time() - self._window_start
+                self.total_elapsed_time += duration
+                self.counted_steps += self._window_steps
+                self._window_start = None
+                self._maybe_report(report_speed,
+                                   duration / max(1, self._window_steps))
+
+    def _maybe_report(self, report_speed: bool, step_duration: float) -> None:
+        if report_speed and self.steps_per_output and \
+                self.global_step_count % self.steps_per_output == 0:
+            self.logging(
+                f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                f"global_step={self.global_step_count}, "
+                f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
+                f"CurrSamplesPerSec={self.batch_size * self.num_workers / max(step_duration, 1e-12):.4f}")
 
     def avg_samples_per_sec(self) -> float:
-        if self.global_step_count > self.start_step:
+        if self.counted_steps > 0 and self.total_elapsed_time > 0:
             samples_per_step = self.batch_size * self.num_workers
-            total_step_offset = self.global_step_count - self.start_step
-            avg_time_per_step = self.total_elapsed_time / max(total_step_offset, 1)
+            avg_time_per_step = self.total_elapsed_time / self.counted_steps
             return samples_per_step / max(avg_time_per_step, 1e-12)
         return float("-1")
