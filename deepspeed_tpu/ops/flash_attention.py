@@ -1,19 +1,26 @@
-"""Flash attention — Pallas TPU kernels with custom VJP.
+"""Flash attention — Pallas TPU kernels with custom VJP and block-sparsity.
 
 The TPU-native replacement for the reference's fused attention core inside
 the transformer kernel (csrc/transformer/softmax_kernels.cu +
 strided_batch_gemm.h: QK^T → scale+mask softmax → AV, with saved softmax
-output replayed in backward). On TPU the dense [S,S] fp32 score tensor is
-the HBM bottleneck, so we never materialize it: the classic flash pattern
-computes attention block-by-block in VMEM with a running (max, sum)
-softmax, and the backward recomputes scores per block from the saved
-logsumexp — the same memory story as the reference's
+output replayed in backward) AND its Triton block-sparse kernels
+(ops/sparse_attention/trsrc/matmul.tr, softmax_fwd.tr). On TPU the dense
+[S,S] fp32 score tensor is the HBM bottleneck, so we never materialize it:
+the classic flash pattern computes attention block-by-block in VMEM with a
+running (max, sum) softmax, and the backward recomputes scores per block
+from the saved logsumexp — the same memory story as the reference's
 ``attn_dropout_checkpoint`` knob taken to its limit.
+
+One kernel family serves three modes via static specialization:
+- dense bidirectional (layout=None, causal=False)
+- causal (block-skip above the diagonal band)
+- block-sparse (an int32 layout [H, nQ, nK] gates each (q-block, k-block)
+  pair — the splash-attention pattern; masked blocks skip their matmuls)
 
 Layout: kernels run over [BH, S, D] (batch×heads flattened, head_dim last).
 Grid is (BH, q_blocks, k_blocks); the innermost (k) dimension iterates
 sequentially on TPU so VMEM scratch carries the running softmax state
-across k-blocks of one q-block. Causal skips fully-masked k-blocks.
+across k-blocks of one q-block.
 """
 from __future__ import annotations
 
@@ -44,11 +51,41 @@ def _pick_block(s: int, target: int = 512) -> int:
     return s  # small sequences: single block
 
 
+def _run_pred(causal: bool, qi, kj, bq: int, bk: int, layout_block=None):
+    """Static-or-traced predicate for whether a (q,k) block pair runs."""
+    conds = []
+    if causal:
+        conds.append(kj * bk < (qi + 1) * bq)
+    if layout_block is not None:
+        conds.append(layout_block != 0)
+    if not conds:
+        return True
+    pred = conds[0]
+    for c in conds[1:]:
+        pred = jnp.logical_and(pred, c)
+    return pred
+
+
+def _causal_mask(s, qi, kj, bq: int, bk: int, transposed: bool = False):
+    if transposed:
+        krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + kj * bk
+        qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qi * bq
+        return jnp.where(qcols >= krows, s, NEG_INF)
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
+    return jnp.where(rows >= cols, s, NEG_INF)
+
+
 # --------------------------------------------------------------------- #
 # Forward kernel
 # --------------------------------------------------------------------- #
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, scale: float, causal: bool, bq: int, bk: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
+                has_layout: bool):
+    if has_layout:
+        (layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -58,10 +95,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    # Causal: skip k-blocks strictly above the diagonal band.
-    run = True
-    if causal:
-        run = kj * bk < (qi + 1) * bq
+    run = _run_pred(causal, qi, kj, bq, bk,
+                    _layout_gate(layout_ref, qi, kj) if has_layout else None)
 
     @pl.when(run)
     def _compute():
@@ -72,9 +107,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BQ, BK]
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, bq, bk)
 
         m_prev = m_scr[:, 0:1]                            # [BQ, 1]
         m_cur = jnp.max(s, axis=1, keepdims=True)
@@ -97,23 +130,65 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         lse_ref[0, 0] = (m_scr[:, 0] + jnp.log(l_safe[:, 0]))
 
 
-def _flash_fwd(q, k, v, scale: float, causal: bool):
-    """q,k,v: [BH, S, D] → (o [BH,S,D], lse [BH,S] f32)."""
+def _pad_layout(layout):
+    """Pad [H, nQ, nK] to TPU tile multiples (8, 128) on the last two dims
+    so the gate can ride a (1, 8, 128) VMEM block; the kernel reads
+    [0, qi % 8, kj % 128] from the (qi // 8, kj // 128) block."""
+    H, nQ, nK = layout.shape
+    pq = (-nQ) % 8
+    pk = (-nK) % 128
+    if pq or pk:
+        layout = jnp.pad(layout, ((0, 0), (0, pq), (0, pk)))
+    return layout
+
+
+def _layout_gate(layout_ref, qi, kj):
+    """Read one int gate out of the (1, 8, 128) layout tile. Dynamic scalar
+    indexing into VMEM doesn't lower on TPU; a masked VPU reduction does."""
+    tile = layout_ref[0]                                   # [8, 128] int32
+    r = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 0) == (qi % 8)
+    c = jax.lax.broadcasted_iota(jnp.int32, (8, 128), 1) == (kj % 128)
+    return jnp.sum(jnp.where(jnp.logical_and(r, c), tile, 0))
+
+
+def _layout_spec(num_heads: int, role: str):
+    """BlockSpec for the padded layout; bh grid index → head index."""
+    if role == "fwd" or role == "dq":
+        return pl.BlockSpec((1, 8, 128),
+                            lambda b, i, j: (b % num_heads, i // 8, j // 128))
+    # dkv grid is (BH, nK, nQ)
+    return pl.BlockSpec((1, 8, 128),
+                        lambda b, j, i: (b % num_heads, i // 8, j // 128))
+
+
+def _flash_fwd(q, k, v, layout, scale: float, causal: bool):
+    """q,k,v: [BH, S, D]; layout int32 [H, nQ, nK] or None.
+    → (o [BH,S,D], lse [BH,1,S] f32)."""
     BH, S, D = q.shape
     Sk = k.shape[1]
-    bq, bk = _pick_block(S), _pick_block(Sk)
+    has_layout = layout is not None
+    if has_layout:
+        # Kernel blocks must match the layout's block granularity.
+        bq = bk = S // layout.shape[-1]
+    else:
+        bq, bk = _pick_block(S), _pick_block(Sk)
     grid = (BH, S // bq, Sk // bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk)
+                               bq=bq, bk=bk, has_layout=has_layout)
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = (q, k, v)
+    if has_layout:
+        in_specs = [_layout_spec(layout.shape[0], "fwd")] + in_specs
+        args = (_pad_layout(layout),) + args
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
@@ -128,15 +203,21 @@ def _flash_fwd(q, k, v, scale: float, causal: bool):
             pltpu.VMEM((bq, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
 # --------------------------------------------------------------------- #
 # Backward kernels
 # --------------------------------------------------------------------- #
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   acc_scr, *, scale: float, causal: bool, bq: int, bk: int):
+def _bwd_dq_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
+                   has_layout: bool):
+    if has_layout:
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+         acc_scr) = refs
     qi, kj = pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
@@ -144,9 +225,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    run = True
-    if causal:
-        run = kj * bk < (qi + 1) * bq
+    run = _run_pred(causal, qi, kj, bq, bk,
+                    _layout_gate(layout_ref, qi, kj) if has_layout else None)
 
     @pl.when(run)
     def _compute():
@@ -158,9 +238,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
-            cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
-            s = jnp.where(rows >= cols, s, NEG_INF)
+            s = _causal_mask(s, qi, kj, bq, bk)
         p = jnp.exp(s - lse)                              # softmax [BQ, BK]
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -175,9 +253,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, dk_scr, dv_scr,
-                    *, scale: float, causal: bool, bq: int, bk: int):
+def _bwd_dkv_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
+                    has_layout: bool):
+    if has_layout:
+        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_scr, dv_scr) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+         dk_scr, dv_scr) = refs
     kj, qi = pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
@@ -186,9 +269,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    run = True
-    if causal:
-        run = kj * bk < (qi + 1) * bq
+    run = _run_pred(causal, qi, kj, bq, bk,
+                    _layout_gate(layout_ref, qi, kj) if has_layout else None)
 
     @pl.when(run)
     def _compute():
@@ -201,9 +283,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k, q, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale   # [BK, BQ]
         if causal:
-            krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + kj * bk
-            qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qi * bq
-            s2 = jnp.where(qcols >= krows, s2, NEG_INF)
+            s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
         p2 = jnp.exp(s2 - lse)                            # [BK, BQ] = p.T
         dv_scr[:] += jax.lax.dot_general(
             p2.astype(do.dtype), do, (((1,), (0,)), ((), ())),
@@ -222,43 +302,57 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool):
+def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool):
     BH, S, D = q.shape
     Sk = k.shape[1]
-    bq, bk = _pick_block(S), _pick_block(Sk)
+    has_layout = layout is not None
+    if has_layout:
+        bq = bk = S // layout.shape[-1]
+    else:
+        bq, bk = _pick_block(S), _pick_block(Sk)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH, 1, S]
 
+    dq_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
+    ]
+    dq_args = (q, k, v, do, lse, delta)
+    if has_layout:
+        dq_specs = [_layout_spec(layout.shape[0], "dq")] + dq_specs
+        dq_args = (_pad_layout(layout),) + dq_args
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, has_layout=has_layout),
         grid=(BH, S // bq, Sk // bk),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dq_args)
 
+    dkv_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+        pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
+    ]
+    dkv_args = (q, k, v, do, lse, delta)
+    if has_layout:
+        dkv_specs = [_layout_spec(layout.shape[0], "dkv")] + dkv_specs
+        dkv_args = (_pad_layout(layout),) + dkv_args
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk),
+                          bq=bq, bk=bk, has_layout=has_layout),
         grid=(BH, Sk // bk, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-            pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
@@ -272,59 +366,108 @@ def _flash_bwd(q, k, v, o, lse, do, scale: float, causal: bool):
             pltpu.VMEM((bk, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
 # --------------------------------------------------------------------- #
-# custom_vjp wrapper
+# custom_vjp wrappers (dense/causal and block-sparse variants)
 # --------------------------------------------------------------------- #
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _flash(q, k, v, scale: float, causal: bool):
-    o, _ = _flash_fwd(q, k, v, scale, causal)
+    o, _ = _flash_fwd(q, k, v, None, scale, causal)
     return o
 
 
 def _flash_vjp_fwd(q, k, v, scale, causal):
-    o, lse = _flash_fwd(q, k, v, scale, causal)
+    o, lse = _flash_fwd(q, k, v, None, scale, causal)
     return o, (q, k, v, o, lse)
 
 
 def _flash_vjp_bwd(scale, causal, res, do):
     q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, scale, causal)
+    return _flash_bwd(q, k, v, o, lse, do, None, scale, causal)
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_sparse(q, k, v, layout, scale: float, causal: bool):
+    o, _ = _flash_fwd(q, k, v, layout, scale, causal)
+    return o
+
+
+def _flash_sparse_vjp_fwd(q, k, v, layout, scale, causal):
+    o, lse = _flash_fwd(q, k, v, layout, scale, causal)
+    return o, (q, k, v, layout, o, lse)
+
+
+def _flash_sparse_vjp_bwd(scale, causal, res, do):
+    q, k, v, layout, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, layout, scale, causal)
+    return dq, dk, dv, None
+
+
+_flash_sparse.defvjp(_flash_sparse_vjp_fwd, _flash_sparse_vjp_bwd)
+
+
+def _to_bh(x):
+    B, S, nH, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     mask: Optional[jnp.ndarray] = None, causal: bool = False,
                     attn_dropout: float = 0.0, rng=None,
-                    deterministic: bool = True) -> jnp.ndarray:
+                    deterministic: bool = True,
+                    layout: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Drop-in for models.transformer.dense_attention: q,k,v [B,S,nH,dH].
 
+    ``layout`` [nH, S//block, S//block] int32 enables block-sparse mode.
     Falls back to the dense path for additive masks or attention dropout
     (the reference keeps a non-fused path for the same cases,
     transformer.py:153 vs the vanilla BertSelfAttention it replaces).
     """
-    if mask is not None or (attn_dropout > 0.0 and not deterministic):
-        from ..models.transformer import dense_attention
-        return dense_attention(q, k, v, mask=mask, causal=causal,
-                               attn_dropout=attn_dropout, rng=rng,
-                               deterministic=deterministic)
     B, S, nH, D = q.shape
-    if S % 128 != 0:
+    layout_block = None
+    if layout is not None:
+        if layout.ndim != 3 or layout.shape[0] != nH or \
+                layout.shape[-2] != layout.shape[-1] or \
+                S % layout.shape[-1] != 0:
+            raise ValueError(
+                f"layout shape {layout.shape} incompatible with "
+                f"{nH} heads / seq {S}: need (num_heads, S//block, S//block)")
+        # The Pallas path needs 128-aligned kernel blocks; a sparse layout
+        # fixes the block to S // n_blocks, which must itself be 128-aligned.
+        layout_block = S // layout.shape[-1]
+    if mask is not None or (attn_dropout > 0.0 and not deterministic) \
+            or S % 128 != 0 \
+            or (layout_block is not None and layout_block % 128 != 0):
         from ..models.transformer import dense_attention
+        if layout is not None:
+            mask = _layout_to_mask(layout, S, mask)
         return dense_attention(q, k, v, mask=mask, causal=causal,
                                attn_dropout=attn_dropout, rng=rng,
                                deterministic=deterministic)
     scale = 1.0 / math.sqrt(D)
-    qt = q.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
-    kt = k.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
-    vt = v.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
-    o = _flash(qt, kt, vt, scale, causal)
+    qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
+    if layout is None:
+        o = _flash(qt, kt, vt, scale, causal)
+    else:
+        o = _flash_sparse(qt, kt, vt, jnp.asarray(layout, jnp.int32),
+                          scale, causal)
     return o.reshape(B, nH, S, D).transpose(0, 2, 1, 3)
+
+
+def _layout_to_mask(layout, seq_len: int, mask):
+    """Expand a block layout to an additive [1, nH, S, S] element mask
+    (dense-fallback semantics of the sparse path)."""
+    layout = jnp.asarray(layout)
+    block = seq_len // layout.shape[-1]
+    elem = jnp.repeat(jnp.repeat(layout, block, axis=-2), block, axis=-1)
+    add = jnp.where(elem[None] != 0, 0.0, NEG_INF).astype(jnp.float32)
+    return add if mask is None else add + mask
 
 
 def auto_attention(q, k, v, mask=None, causal=False, attn_dropout=0.0,

@@ -1,0 +1,19 @@
+"""Block-sparse attention.
+
+Capability parity with reference ``deepspeed/ops/sparse_attention/``
+(SparsityConfig hierarchy sparsity_config.py:9-663, Triton SDD/DSD/DDS
+matmul + masked softmax kernels, SparseSelfAttention composition) —
+re-designed for TPU: the layout generators are pure numpy, and the kernel is
+a layout-gated Pallas flash-attention (never materializes the [S,S] scores;
+skips masked blocks), cf. the splash-attention pattern.
+"""
+from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
+                              FixedSparsityConfig, VariableSparsityConfig,
+                              BigBirdSparsityConfig, BSLongformerSparsityConfig)
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+
+__all__ = [
+    "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
+    "VariableSparsityConfig", "BigBirdSparsityConfig",
+    "BSLongformerSparsityConfig", "SparseSelfAttention", "sparse_attention",
+]

@@ -1,0 +1,94 @@
+"""SparseSelfAttention — layout-driven attention composition.
+
+Parity with reference ``sparse_self_attention.py:105-164`` (QK^T → masked
+block-sparse softmax → AV over a SparsityConfig layout) and the Triton
+MatMul/Softmax pair it composes. Here the whole pipeline is ONE layout-gated
+Pallas flash kernel (ops/flash_attention.py): no LUT building, no SDD/DSD/
+DDS decomposition — the layout gates (q-block, k-block) pairs directly and
+masked blocks are skipped.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..flash_attention import flash_attention, _layout_to_mask
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                     layout: jnp.ndarray, causal: bool = False,
+                     mask: Optional[jnp.ndarray] = None,
+                     attn_dropout: float = 0.0, rng=None,
+                     deterministic: bool = True) -> jnp.ndarray:
+    """q,k,v: [B, S, nH, dH]; layout: [nH, S//block, S//block] int.
+
+    The layout must give every query row at least one visible block (all
+    five shipped SparsityConfigs do — local windows include the diagonal),
+    otherwise that row's softmax denominator is empty.
+    """
+    return flash_attention(q, k, v, mask=mask, causal=causal,
+                           attn_dropout=attn_dropout, rng=rng,
+                           deterministic=deterministic, layout=layout)
+
+
+def sparse_attention_reference(q, k, v, layout, causal: bool = False):
+    """Dense-masked reference implementation (for tests; the reference's
+    own tests compare the Triton path against a dense torch softmax the
+    same way, test_sparse_attention.py:16-97)."""
+    from ...models.transformer import dense_attention
+    S = q.shape[1]
+    return dense_attention(q, k, v, mask=_layout_to_mask(layout, S, None),
+                           causal=causal)
+
+
+class SparseSelfAttention:
+    """Module-style wrapper owning a SparsityConfig and a layout cache
+    (reference sparse_self_attention.py:24-58 master-layout caching)."""
+
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add",
+                 attn_mask_mode: str = "mul",
+                 max_seq_length: int = 2048):
+        self.sparsity_config = sparsity_config or FixedSparsityConfig(num_heads=4)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._layout_cache: Dict[int, np.ndarray] = {}
+
+    def get_layout(self, seq_len: int) -> np.ndarray:
+        if seq_len not in self._layout_cache:
+            self._layout_cache[seq_len] = \
+                self.sparsity_config.make_layout(seq_len)
+        return self._layout_cache[seq_len]
+
+    def __call__(self, query: jnp.ndarray, key: jnp.ndarray,
+                 value: jnp.ndarray,
+                 key_padding_mask: Optional[jnp.ndarray] = None,
+                 attn_mask: Optional[jnp.ndarray] = None,
+                 rng=None, deterministic: bool = True) -> jnp.ndarray:
+        """query/key/value: [B, S, nH, dH] (unlike the reference's
+        [B, nH, S, dH] torch layout — [B, S, ...] is the JAX norm here).
+
+        key_padding_mask: [B, S], 1 = keep. attn_mask: additive
+        broadcastable to [B, 1, S, S] ("add" mode) or multiplicative 0/1
+        ("mul" mode), matching the reference's two mask modes
+        (sparse_self_attention.py:118-141).
+        """
+        S = query.shape[1]
+        layout = self.get_layout(S)
+        mask = None
+        if key_padding_mask is not None:
+            pad = (1.0 - key_padding_mask.astype(jnp.float32))
+            mask = pad[:, None, None, :] * -1e30
+            if self.key_padding_mask_mode != "add":
+                raise NotImplementedError("mul key_padding_mask_mode")
+        if attn_mask is not None:
+            if self.attn_mask_mode == "mul":
+                attn_mask = jnp.where(attn_mask != 0, 0.0, -1e30)
+            mask = attn_mask if mask is None else mask + attn_mask
+        return sparse_attention(query, key, value, layout,
+                                causal=False, mask=mask, rng=rng,
+                                deterministic=deterministic)
