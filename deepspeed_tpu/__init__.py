@@ -35,12 +35,17 @@ def initialize(args=None, model=None, optimizer=None, model_params=None,
         raise ValueError("DeepSpeed requires a config via `config=`, "
                          "`config_params=`, or args.deepspeed_config")
 
-    if isinstance(model, PipelineModule):
+    from .models.gpt2_pipe import PipeSpec
+    if isinstance(model, (PipelineModule, PipeSpec)):
+        pipe_mpu = mpu
+        if pipe_mpu is None and isinstance(model, PipelineModule):
+            pipe_mpu = model.mpu()
         engine = PipelineEngine(args=args, model=model, optimizer=optimizer,
                                 model_params=model_params, training_data=training_data,
-                                lr_scheduler=lr_scheduler, mpu=model.mpu() if mpu is None else mpu,
+                                lr_scheduler=lr_scheduler, mpu=pipe_mpu,
                                 dist_init_required=dist_init_required,
-                                collate_fn=collate_fn, config=cfg, rng=rng)
+                                collate_fn=collate_fn, config=cfg, rng=rng,
+                                mesh=mesh)
     else:
         engine = DeepSpeedEngine(args=args, model=model, optimizer=optimizer,
                                  model_params=model_params, training_data=training_data,

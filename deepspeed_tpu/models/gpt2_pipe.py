@@ -1,0 +1,77 @@
+"""GPT-2 expressed for the SPMD pipeline (pipe/spmd.py model contract).
+
+The reference pipelines GPT-2 via Megatron's PipelineModule layer lists
+(docs/_tutorials/pipeline.md); here the pipelined form is derived directly
+from the same param pytree as models.gpt2: shared (embeddings + final LN,
+replicated over pp — the tied embed/unembed pair, TiedLayerSpec parity) and
+the stacked transformer blocks (sharded over pp on the layer dim).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from .gpt2 import GPT2Config, gpt2_init
+from .transformer import apply_blocks, block_param_shardings, layer_norm
+from ..runtime.pipe.spmd import pipeline_param_shardings
+
+
+@dataclasses.dataclass
+class PipeSpec:
+    """Uniform-stage pipeline model: funcs + params + shardings.
+
+    The PipelineEngine consumes this for compiled pp>1 execution; see
+    pipe/spmd.py for the contract.
+    """
+    embed_fn: Any
+    stage_fn: Any
+    head_fn: Any
+    params: Dict[str, Any]
+    shardings: Dict[str, Any]
+    num_layers: int
+
+    def loss_fn(self, num_stages: int, num_micro: int, mesh):
+        from ..runtime.pipe.spmd import spmd_pipeline_loss
+        return spmd_pipeline_loss(self.embed_fn, self.stage_fn, self.head_fn,
+                                  num_stages, num_micro, mesh)
+
+
+def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
+                   mp_axis: str = "model") -> PipeSpec:
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    flat = gpt2_init(rng, cfg)
+    params = {
+        "shared": {"wte": flat["wte"], "wpe": flat["wpe"],
+                   "ln_f_scale": flat["ln_f_scale"],
+                   "ln_f_bias": flat["ln_f_bias"]},
+        "blocks": flat["blocks"],
+    }
+    shardings = pipeline_param_shardings(
+        shared_specs={"wte": P(mp_axis, None), "wpe": P(None, None),
+                      "ln_f_scale": P(None), "ln_f_bias": P(None)},
+        block_specs=block_param_shardings(mp_axis))
+
+    def embed_fn(shared, tokens, rng):
+        S = tokens.shape[-1]
+        return shared["wte"].astype(cfg.dtype)[tokens] + \
+            shared["wpe"].astype(cfg.dtype)[None, :S]
+
+    def stage_fn(blocks_local, x, rng):
+        return apply_blocks(blocks_local, x, cfg, rng=rng,
+                            deterministic=cfg.hidden_dropout == 0.0)
+
+    def head_fn(shared, x, targets, rng):
+        x = layer_norm(x, shared["ln_f_scale"], shared["ln_f_bias"],
+                       cfg.layer_norm_eps)
+        logits = (x @ shared["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
+                    params=params, shardings=shardings,
+                    num_layers=cfg.num_layers)

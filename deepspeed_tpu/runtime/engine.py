@@ -383,6 +383,12 @@ class DeepSpeedEngine:
     def is_gradient_accumulation_boundary(self) -> bool:
         return (self.micro_steps + 1) % self.gradient_accumulation_steps() == 0
 
+    def _scan_microbatches(self) -> int:
+        """How many micro-batches the jitted train step scans over. The
+        pipeline engine overrides this to 1: its loss_fn consumes ALL
+        grad-accum micro-batches in one pipelined pass."""
+        return self.gradient_accumulation_steps()
+
     @property
     def optimizer(self):
         return self.tx
@@ -419,7 +425,7 @@ class DeepSpeedEngine:
     # The jitted train step
     # ------------------------------------------------------------------ #
     def _build_train_step(self):
-        gas = self.gradient_accumulation_steps()
+        gas = self._scan_microbatches()
         clip = self.gradient_clipping()
         fp16 = self.config.fp16_enabled
         static_scale = self._static_loss_scale
@@ -541,7 +547,7 @@ class DeepSpeedEngine:
         """Reshape to [gas, per_micro_step, ...]. Device arrays stay on
         device (np.asarray on a jax.Array would be a synchronous D2H
         round-trip every step — ruinous over a tunneled backend)."""
-        gas = self.gradient_accumulation_steps()
+        gas = self._scan_microbatches()
 
         def reshape(x):
             if not isinstance(x, (jax.Array, np.ndarray)):

@@ -28,16 +28,54 @@ def _is_flax_module(layer) -> bool:
 
 
 class PipelineEngine(DeepSpeedEngine):
-    """Engine for PipelineModule models."""
+    """Engine for pipelined models.
 
-    def __init__(self, args=None, model: PipelineModule = None, optimizer=None,
+    Two model forms:
+    - ``PipeSpec`` (models/gpt2_pipe.py): uniform stages → COMPILED SPMD
+      pipeline over the pp mesh axis (pipe/spmd.py). All grad-accum
+      micro-batches flow through the pipeline inside ONE jitted step; the
+      instruction schedule (schedule.py) is realized by the scan+ppermute
+      program and its autodiff transpose.
+    - ``PipelineModule`` (layer list): composed into a single fused function
+      — correct on pp=1 meshes (heterogeneous per-stage programs don't fit
+      one SPMD program; express such models as a PipeSpec instead).
+    """
+
+    def __init__(self, args=None, model=None, optimizer=None,
                  model_params=None, training_data=None, lr_scheduler=None,
                  mpu=None, dist_init_required=None, collate_fn=None,
-                 config=None, rng=None, mesh=None):
+                 config=None, rng=None, mesh=None, num_micro_batches=None):
+        from ...models.gpt2_pipe import PipeSpec
+        self.pipeline_module = None
+        self._pipe_spec = None
+        rng0 = rng if rng is not None else jax.random.PRNGKey(0)
+
+        if isinstance(model, PipeSpec):
+            self._pipe_spec = model
+            # Mesh must exist before the loss fn; build from config if needed.
+            mesh = mesh if mesh is not None else self._build_mesh(config)
+            pp = int(mesh.shape.get("pipe", 1))
+            if pp > 1 and model.num_layers % pp != 0:
+                raise ValueError(f"{model.num_layers} layers not divisible "
+                                 f"by {pp} pipeline stages")
+            from ...parallel.topology import DP_AXIS
+            gas = self._peek_gas(config, int(mesh.shape.get(DP_AXIS, 1)))
+            m = num_micro_batches or gas
+            self._num_micro = m
+            loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh)
+            super().__init__(args=args, model=loss_fn, optimizer=optimizer,
+                             model_params=model_params or model.params,
+                             training_data=training_data,
+                             lr_scheduler=lr_scheduler, mpu=mpu,
+                             dist_init_required=dist_init_required,
+                             collate_fn=collate_fn, config=config, rng=rng,
+                             mesh=mesh, param_shardings=model.shardings)
+            log_dist(f"PipelineEngine: compiled SPMD pipeline pp={pp}, "
+                     f"micro_batches={m}, layers={model.num_layers}", ranks=[0])
+            return
+
         assert isinstance(model, PipelineModule)
         self.pipeline_module = model
-
-        rng0 = rng if rng is not None else jax.random.PRNGKey(0)
         if model_params is None:
             model_params = self._init_layer_params(model, training_data, rng0,
                                                    config)
@@ -51,9 +89,34 @@ class PipelineEngine(DeepSpeedEngine):
         pp = int(self.mesh.shape.get("pipe", 1))
         if pp > 1:
             raise NotImplementedError(
-                "pp>1 compiled 1F1B execution lands with pipe/schedule.py; "
-                "use pp=1 (layers still partitioned logically) for now")
+                "pp>1 needs uniform stages: express the model as a PipeSpec "
+                "(models/gpt2_pipe.py) for the compiled SPMD pipeline")
         log_dist(self.pipeline_module.describe(), ranks=[0])
+
+    @staticmethod
+    def _peek_gas(config, dp: int = 1) -> int:
+        """Read gradient_accumulation_steps before the base engine parses
+        the full config (the micro-batch count of the pipeline)."""
+        from ..config import DeepSpeedConfig
+        from ..config_utils import load_config_json
+        if isinstance(config, str):
+            config = load_config_json(config)
+        if isinstance(config, DeepSpeedConfig):
+            return config.gradient_accumulation_steps
+        if isinstance(config, dict):
+            tb = config.get("train_batch_size")
+            mb = config.get("train_micro_batch_size_per_gpu")
+            gas = config.get("gradient_accumulation_steps")
+            if gas:
+                return int(gas)
+            if tb and mb:
+                return max(1, int(tb) // (int(mb) * dp))
+        return 1
+
+    def _scan_microbatches(self) -> int:
+        # The pipelined loss consumes every micro-batch in one pass.
+        return 1 if self._pipe_spec is not None else \
+            self.gradient_accumulation_steps()
 
     # ------------------------------------------------------------------ #
     def _init_layer_params(self, model: PipelineModule, training_data, rng,

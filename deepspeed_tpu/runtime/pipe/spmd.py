@@ -1,0 +1,144 @@
+"""SPMD collective pipeline — the compiled 1F1B/GPipe execution path.
+
+The reference interprets instruction schedules imperatively per rank
+(pipe/engine.py:1135-1161 dispatch map) with p2p-as-broadcast transfers
+(p2p.py:31-55). The TPU-native execution is a SINGLE jitted collective
+program: ``shard_map`` over the ``pipe`` mesh axis holds one stage's
+parameters per device; a ``lax.scan`` over ``M + P - 1`` ticks runs
+(stage-compute → ppermute-to-next-stage) per tick — the forward wavefront of
+the schedule. JAX autodiff through the scan + ppermute generates the reverse
+wavefront (grad ticks with ppermute in the opposite direction), i.e. the
+backward half of the schedule, with per-tick rematerialization via
+``jax.checkpoint`` bounding activation memory the way 1F1B's buffer count
+does (schedule.py:237-242).
+
+Composition: the ``pipe`` axis is *manual* (shard_map ``axis_names``); data/
+model/seq axes stay *auto*, so GSPMD still partitions the batch over dp and
+the stage matmuls over mp inside the per-stage program — 3D parallelism as
+mesh composition (reference topology.py:246-250).
+
+Model contract (uniform stages — the shape of every pipelined transformer):
+- ``embed_fn(shared, tokens, rng) -> x``            (runs logically on stage 0)
+- ``stage_fn(blocks_local, x, rng) -> x``           (L/P stacked layers)
+- ``head_fn(shared, x, targets, rng) -> scalar``    (runs on stage P-1)
+Params pytree: ``{"shared": replicated-over-pipe, "blocks": leaf[0] dim
+stacked over layers, sharded over pipe}``. Weight tying (e.g. embedding =
+unembedding) is structural: both embed_fn and head_fn read it from
+``shared``; shard_map's transpose inserts the cross-stage psum of its grads
+(the ReduceTiedGrads instruction, for free).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...parallel.topology import PP_AXIS
+
+
+def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
+                       head_fn: Callable, num_stages: int,
+                       num_micro_batches: int, mesh: Mesh,
+                       remat: bool = True) -> Callable:
+    """Build ``loss_fn(params, batch, rng) -> scalar`` running the pipeline.
+
+    ``batch``: (tokens, targets) with leading dim M*mb (micro-stacked by the
+    caller) or a single array whose targets are derived by the head_fn.
+    """
+    M, Pstages = num_micro_batches, num_stages
+
+    def per_stage(shared, blocks_local, micro_tokens, micro_targets, rng):
+        r = lax.axis_index(PP_AXIS)
+        stage = jax.checkpoint(stage_fn) if remat else stage_fn
+
+        def tick(carry, t):
+            buf, loss_acc = carry
+            in_idx = jnp.clip(t, 0, M - 1)
+            tokens_t = lax.dynamic_index_in_dim(
+                micro_tokens, in_idx, 0, keepdims=False)
+            key_t = jax.random.fold_in(rng, t)
+            x_in = jnp.where(r == 0,
+                             embed_fn(shared, tokens_t, key_t).astype(buf.dtype),
+                             buf)
+            y = stage(blocks_local, x_in, key_t)
+
+            out_idx = t - (Pstages - 1)
+            tgt_t = lax.dynamic_index_in_dim(
+                micro_targets, jnp.clip(out_idx, 0, M - 1), 0, keepdims=False)
+            emit = jnp.logical_and(r == Pstages - 1, out_idx >= 0)
+            loss_t = lax.cond(
+                emit,
+                lambda: head_fn(shared, y, tgt_t, key_t).astype(jnp.float32),
+                lambda: lax.pvary(jnp.asarray(0.0, jnp.float32), PP_AXIS))
+            loss_acc = loss_acc + loss_t
+
+            # Ship activations to the next stage (the SendActivation /
+            # RecvActivation pair as one collective-permute; reverse-mode AD
+            # of this is the SendGrad/RecvGrad pair).
+            buf_next = lax.ppermute(
+                y, PP_AXIS, [(i, i + 1) for i in range(Pstages - 1)])
+            return (buf_next, loss_acc), None
+
+        # Probe the embed output shape to size the rotating buffer.
+        tok0 = jax.tree_util.tree_map(lambda a: a[0], micro_tokens)
+        x0 = jax.eval_shape(lambda s, tk: embed_fn(s, tk, rng), shared, tok0)
+        buf0 = lax.pvary(jnp.zeros(x0.shape, x0.dtype), PP_AXIS)
+
+        (_, loss_sum), _ = lax.scan(
+            tick, (buf0, lax.pvary(jnp.asarray(0.0, jnp.float32), PP_AXIS)),
+            jnp.arange(M + Pstages - 1))
+        return lax.psum(loss_sum, PP_AXIS) / M
+
+    mapped = jax.shard_map(
+        per_stage, mesh=mesh,
+        in_specs=(P(), P(PP_AXIS), P(), P(), P()),
+        out_specs=P(),
+        axis_names={PP_AXIS})
+
+    def loss_fn(params, batch, rng):
+        tokens, targets = _split_batch(batch)
+        micro_tokens = _to_micro(tokens, M)
+        micro_targets = _to_micro(targets, M)
+        return mapped(params["shared"], params["blocks"],
+                      micro_tokens, micro_targets, rng)
+
+    return loss_fn
+
+
+def _split_batch(batch):
+    if isinstance(batch, (tuple, list)):
+        return batch[0], batch[1]
+    # single token array [B, S+1]: next-token objective
+    return batch[:, :-1], batch[:, 1:]
+
+
+def _to_micro(x, m: int):
+    def reshape(a):
+        assert a.shape[0] % m == 0, \
+            f"batch dim {a.shape[0]} not divisible by {m} micro-batches"
+        return a.reshape((m, a.shape[0] // m) + a.shape[1:])
+    return jax.tree_util.tree_map(reshape, x)
+
+
+def pipeline_param_shardings(shared_specs: Any, block_specs: Any) -> Dict[str, Any]:
+    """Compose TP block specs with the pipe axis: the stacked layer dim
+    (leading) becomes the pipe dim; shared params replicate over pipe."""
+    def add_pipe(spec: P) -> P:
+        parts = list(spec)
+        if parts and parts[0] is None:
+            parts[0] = PP_AXIS
+        elif not parts:
+            parts = [PP_AXIS]
+        else:
+            raise ValueError(f"block spec {spec} already shards dim 0")
+        return P(*parts)
+
+    return {
+        "shared": shared_specs,
+        "blocks": jax.tree_util.tree_map(
+            add_pipe, block_specs, is_leaf=lambda x: isinstance(x, P)),
+    }

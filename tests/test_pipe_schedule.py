@@ -1,0 +1,101 @@
+"""Schedule instruction-sequence tests (reference test_pipe_schedule.py)."""
+import pytest
+
+from deepspeed_tpu.runtime.pipe.schedule import (
+    BackwardPass, ForwardPass, InferenceSchedule, LoadMicroBatch,
+    OptimizerStep, RecvActivation, RecvGrad, ReduceGrads, ReduceTiedGrads,
+    SendActivation, SendGrad, TrainSchedule)
+
+
+def _flat(schedule):
+    return [cmd for step in schedule for cmd in step]
+
+
+class TestInferenceSchedule:
+    def test_first_stage_loads_last_sends_nothing(self):
+        sched = InferenceSchedule(micro_batches=4, stages=2, stage_id=0)
+        cmds = _flat(sched)
+        assert sum(isinstance(c, LoadMicroBatch) for c in cmds) == 4
+        assert sum(isinstance(c, ForwardPass) for c in cmds) == 4
+        assert sum(isinstance(c, SendActivation) for c in cmds) == 4
+        assert not any(isinstance(c, RecvActivation) for c in cmds)
+
+        last = InferenceSchedule(micro_batches=4, stages=2, stage_id=1)
+        cmds = _flat(last)
+        assert sum(isinstance(c, RecvActivation) for c in cmds) == 4
+        assert not any(isinstance(c, SendActivation) for c in cmds)
+
+    def test_total_steps(self):
+        sched = InferenceSchedule(micro_batches=4, stages=3, stage_id=1)
+        assert len(list(sched.steps())) == 4 + 3 - 1
+
+
+class TestTrainSchedule:
+    @pytest.mark.parametrize("stages,micro", [(2, 4), (4, 8), (4, 4), (1, 2)])
+    def test_every_micro_batch_forward_and_backward(self, stages, micro):
+        for sid in range(stages):
+            sched = TrainSchedule(micro_batches=micro, stages=stages,
+                                  stage_id=sid)
+            cmds = _flat(sched)
+            fwd = [c for c in cmds if isinstance(c, ForwardPass)]
+            bwd = [c for c in cmds if isinstance(c, BackwardPass)]
+            assert len(fwd) == micro, f"stage {sid}"
+            assert len(bwd) == micro, f"stage {sid}"
+
+    def test_forward_precedes_backward_per_buffer(self):
+        sched = TrainSchedule(micro_batches=4, stages=2, stage_id=0)
+        seen_fwd = set()
+        for step in sched:
+            for cmd in step:
+                if isinstance(cmd, ForwardPass):
+                    seen_fwd.add(cmd.buffer_id)
+                if isinstance(cmd, BackwardPass):
+                    assert cmd.buffer_id in seen_fwd
+                    seen_fwd.discard(cmd.buffer_id)
+
+    def test_single_optimizer_step_at_end(self):
+        sched = TrainSchedule(micro_batches=4, stages=2, stage_id=1)
+        steps = list(sched.steps())
+        cmds = _flat(steps)
+        assert sum(isinstance(c, OptimizerStep) for c in cmds) == 1
+        assert any(isinstance(c, OptimizerStep) for c in steps[-1])
+        assert sum(isinstance(c, ReduceGrads) for c in cmds) == 1
+        assert sum(isinstance(c, ReduceTiedGrads) for c in cmds) == 1
+
+    def test_comm_pairing_across_stages(self):
+        """Every SendActivation on stage s has a RecvActivation on s+1, and
+        every SendGrad on s a RecvGrad on s-1 (same totals)."""
+        stages, micro = 3, 6
+        send_act = {s: 0 for s in range(stages)}
+        recv_act = {s: 0 for s in range(stages)}
+        send_grad = {s: 0 for s in range(stages)}
+        recv_grad = {s: 0 for s in range(stages)}
+        for s in range(stages):
+            for c in _flat(TrainSchedule(micro, stages, s)):
+                send_act[s] += isinstance(c, SendActivation)
+                recv_act[s] += isinstance(c, RecvActivation)
+                send_grad[s] += isinstance(c, SendGrad)
+                recv_grad[s] += isinstance(c, RecvGrad)
+        for s in range(stages - 1):
+            assert send_act[s] == recv_act[s + 1] == micro
+            assert send_grad[s + 1] == recv_grad[s] == micro
+        assert send_act[stages - 1] == 0 and recv_grad[stages - 1] == 0
+        assert recv_act[0] == 0 and send_grad[0] == 0
+
+    def test_1f1b_buffer_bound(self):
+        """In-flight forwards never exceed num_pipe_buffers (the 1F1B
+        memory guarantee, schedule.py:237-242)."""
+        stages, micro = 4, 16
+        for sid in range(stages):
+            sched = TrainSchedule(micro, stages, sid)
+            bound = sched.num_pipe_buffers()
+            in_flight = 0
+            peak = 0
+            for step in sched:
+                for cmd in step:
+                    if isinstance(cmd, ForwardPass):
+                        in_flight += 1
+                    if isinstance(cmd, BackwardPass):
+                        in_flight -= 1
+                peak = max(peak, in_flight)
+            assert peak <= bound, f"stage {sid}: {peak} > {bound}"
