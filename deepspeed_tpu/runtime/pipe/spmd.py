@@ -12,25 +12,39 @@ backward half of the schedule, with per-tick rematerialization via
 ``jax.checkpoint`` bounding activation memory the way 1F1B's buffer count
 does (schedule.py:237-242).
 
+Division of labor (the load-bearing design decision):
+- INSIDE the manual ``pipe`` region: only the uniform stage body and the
+  ``ppermute`` rotation. Every device executes the identical program every
+  tick — no data-dependent branches, so no mismatched collective rendezvous
+  and no conditional GSPMD collectives.
+- OUTSIDE (plain SPMD over the auto dp/mp axes): the embedding front and the
+  loss head. Both read the tied/shared parameters through ordinary autodiff,
+  so the tied embed/unembed gradient (the reference's ReduceTiedGrads
+  instruction, pipe/engine.py:208-227) is an ordinary sum of two paths in
+  one differentiated program — no explicit cross-stage psum of parameter
+  cotangents is ever constructed.
+
+The pipeline's inputs cross into the manual region replicated-over-pipe in
+float32: the transpose of that boundary is a psum over ``pipe`` of the input
+cotangent, and fp32 keeps that all-reduce off the XLA bf16 promotion path.
+Activations inside the scan run in the model's compute dtype (bf16).
+
 Composition: the ``pipe`` axis is *manual* (shard_map ``axis_names``); data/
 model/seq axes stay *auto*, so GSPMD still partitions the batch over dp and
 the stage matmuls over mp inside the per-stage program — 3D parallelism as
 mesh composition (reference topology.py:246-250).
 
 Model contract (uniform stages — the shape of every pipelined transformer):
-- ``embed_fn(shared, tokens, rng) -> x``            (runs logically on stage 0)
+- ``embed_fn(shared, tokens, rng) -> x``            (computed pre-pipeline)
 - ``stage_fn(blocks_local, x, rng) -> x``           (L/P stacked layers)
-- ``head_fn(shared, x, targets, rng) -> scalar``    (runs on stage P-1)
+- ``head_fn(shared, x, targets, rng) -> scalar``    (computed post-pipeline)
 Params pytree: ``{"shared": replicated-over-pipe, "blocks": leaf[0] dim
-stacked over layers, sharded over pipe}``. Weight tying (e.g. embedding =
-unembedding) is structural: both embed_fn and head_fn read it from
-``shared``; shard_map's transpose inserts the cross-stage psum of its grads
-(the ReduceTiedGrads instruction, for free).
+stacked over layers, sharded over pipe}``.
 """
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict
 
 import jax
 import jax.numpy as jnp
@@ -47,64 +61,82 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
     """Build ``loss_fn(params, batch, rng) -> scalar`` running the pipeline.
 
     ``batch``: (tokens, targets) with leading dim M*mb (micro-stacked by the
-    caller) or a single array whose targets are derived by the head_fn.
+    caller) or a single array whose targets are derived next-token style.
     """
     M, Pstages = num_micro_batches, num_stages
+    T = M + Pstages - 1
 
-    def per_stage(shared, blocks_local, micro_tokens, micro_targets, rng):
+    def per_stage(blocks_local, micro_x32, rng, cdtype):
+        """One pipeline stage's full schedule: T ticks of compute+rotate.
+
+        ``micro_x32``: [M, mb, ...] embedded micro-batches, fp32,
+        replicated over pipe. Returns [1, M, mb, ...] — this stage's
+        collected outputs; only stage P-1's slice is meaningful.
+        """
         r = lax.axis_index(PP_AXIS)
         stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
+        buf0 = lax.pcast(jnp.zeros(micro_x32.shape[1:], cdtype), PP_AXIS, to='varying')
+        out0 = lax.pcast(jnp.zeros(micro_x32.shape, cdtype), PP_AXIS, to='varying')
+
         def tick(carry, t):
-            buf, loss_acc = carry
-            in_idx = jnp.clip(t, 0, M - 1)
-            tokens_t = lax.dynamic_index_in_dim(
-                micro_tokens, in_idx, 0, keepdims=False)
+            buf, out = carry
+            x0 = lax.dynamic_index_in_dim(
+                micro_x32, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            # pvary BEFORE the compute-dtype cast: the transpose of pvary is
+            # a psum over pipe, and keeping it in fp32 keeps that all-reduce
+            # off XLA's bf16 AllReducePromotion path (which CHECK-fails on
+            # sdy-annotated reduction computations in this XLA build).
+            x0 = lax.pcast(x0, PP_AXIS, to='varying').astype(cdtype)
+            x_in = jnp.where(r == 0, x0, buf)
             key_t = jax.random.fold_in(rng, t)
-            x_in = jnp.where(r == 0,
-                             embed_fn(shared, tokens_t, key_t).astype(buf.dtype),
-                             buf)
             y = stage(blocks_local, x_in, key_t)
 
+            # Drain window: stage P-1 banks micro-batch out_idx = t-(P-1).
             out_idx = t - (Pstages - 1)
-            tgt_t = lax.dynamic_index_in_dim(
-                micro_targets, jnp.clip(out_idx, 0, M - 1), 0, keepdims=False)
-            emit = jnp.logical_and(r == Pstages - 1, out_idx >= 0)
-            loss_t = lax.cond(
-                emit,
-                lambda: head_fn(shared, y, tgt_t, key_t).astype(jnp.float32),
-                lambda: lax.pvary(jnp.asarray(0.0, jnp.float32), PP_AXIS))
-            loss_acc = loss_acc + loss_t
+            widx = jnp.clip(out_idx, 0, M - 1)
+            write = jnp.logical_and(r == Pstages - 1, out_idx >= 0)
+            cur = lax.dynamic_index_in_dim(out, widx, 0, keepdims=False)
+            out = lax.dynamic_update_index_in_dim(
+                out, jnp.where(write, y, cur), widx, 0)
 
-            # Ship activations to the next stage (the SendActivation /
-            # RecvActivation pair as one collective-permute; reverse-mode AD
-            # of this is the SendGrad/RecvGrad pair).
+            # Ship activations to the next stage (SendActivation /
+            # RecvActivation as one collective-permute; its reverse-mode
+            # transpose is the SendGrad/RecvGrad pair in the other
+            # direction).
             buf_next = lax.ppermute(
                 y, PP_AXIS, [(i, i + 1) for i in range(Pstages - 1)])
-            return (buf_next, loss_acc), None
+            return (buf_next, out), None
 
-        # Probe the embed output shape to size the rotating buffer.
-        tok0 = jax.tree_util.tree_map(lambda a: a[0], micro_tokens)
-        x0 = jax.eval_shape(lambda s, tk: embed_fn(s, tk, rng), shared, tok0)
-        buf0 = lax.pvary(jnp.zeros(x0.shape, x0.dtype), PP_AXIS)
-
-        (_, loss_sum), _ = lax.scan(
-            tick, (buf0, lax.pvary(jnp.asarray(0.0, jnp.float32), PP_AXIS)),
-            jnp.arange(M + Pstages - 1))
-        return lax.psum(loss_sum, PP_AXIS) / M
-
-    mapped = jax.shard_map(
-        per_stage, mesh=mesh,
-        in_specs=(P(), P(PP_AXIS), P(), P(), P()),
-        out_specs=P(),
-        axis_names={PP_AXIS})
+        (_, out), _ = lax.scan(tick, (buf0, out0), jnp.arange(T))
+        return out[None]
 
     def loss_fn(params, batch, rng):
         tokens, targets = _split_batch(batch)
-        micro_tokens = _to_micro(tokens, M)
+        micro_tokens = _to_micro(tokens, M)      # [M, mb, S]
         micro_targets = _to_micro(targets, M)
-        return mapped(params["shared"], params["blocks"],
-                      micro_tokens, micro_targets, rng)
+        shared = params["shared"]
+
+        # Embedding front (pre-pipeline, auto-sharded over dp/mp). Each
+        # micro-batch gets its own folded key so dropout masks decorrelate.
+        midx = jnp.arange(M)
+        x = jax.vmap(lambda tk, i: embed_fn(
+            shared, tk, jax.random.fold_in(rng, i)))(micro_tokens, midx)
+
+        mapped = jax.shard_map(
+            partial(per_stage, cdtype=x.dtype), mesh=mesh,
+            in_specs=(P(PP_AXIS), P(), P()),
+            out_specs=P(PP_AXIS),
+            axis_names={PP_AXIS})
+        stacked = mapped(params["blocks"], x.astype(jnp.float32), rng)
+        y_last = stacked[-1]                      # [M, mb, ...]
+
+        # Loss head (post-pipeline). Tied params (e.g. wte) contribute here
+        # AND in embed_fn; plain autodiff sums both — ReduceTiedGrads parity.
+        losses = jax.vmap(
+            lambda y, tg, i: head_fn(shared, y, tg, jax.random.fold_in(
+                rng, M + i)))(y_last, micro_targets, midx)
+        return jnp.mean(losses.astype(jnp.float32))
 
     return loss_fn
 
