@@ -304,6 +304,14 @@ class DeepSpeedEngine:
         return dict(static=True, init_scale=1.0, scale_window=1000,
                     min_scale=1.0, hysteresis=2)
 
+    def _grad_shardings(self):
+        """ZeRO stage>=2 gradient shardings over dp (else None)."""
+        if self.zero_optimization_stage() < 2 or self.dp_size <= 1:
+            return None
+        from .zero.partition import grad_shardings
+        return grad_shardings(self.state.params, self.mesh, DP_AXIS,
+                              self._param_specs)
+
     def _make_state_shardings(self) -> EngineState:
         """Params per TP spec (default replicated); ZeRO stage >= 1 shards
         optimizer state over dp, layered on top of the TP spec."""
@@ -443,6 +451,17 @@ class DeepSpeedEngine:
             logger.warning("gradient_predivide_factor has no effect on TPU: "
                            "reductions are fp32-accumulated by XLA")
 
+        # ZeRO-2: grads are BORN dp-sharded. Constraining the accumulation
+        # carry makes XLA compile the cross-dp gradient reduction as
+        # reduce-scatter and keeps only 1/dp of every gradient per chip —
+        # the memory story stage2.py:613-738 implements with hooks+buckets.
+        grad_sh = self._grad_shardings()
+
+        def constrain_grads(g):
+            if grad_sh is None:
+                return g
+            return lax.with_sharding_constraint(g, grad_sh)
+
         def scaled_loss(params, mb, key, scale):
             cparams = _cast_floats(params, compute_dtype)
             out = loss_fn(cparams, mb, key)
@@ -462,13 +481,14 @@ class DeepSpeedEngine:
                 g_acc, loss_acc = carry
                 mb, key = xs
                 (_, raw_loss), grads = grad_fn(state.params, mb, key, scale)
-                g_acc = jax.tree_util.tree_map(jnp.add, g_acc, grads)
+                g_acc = constrain_grads(
+                    jax.tree_util.tree_map(jnp.add, g_acc, grads))
                 return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
 
             keys = jax.random.split(rng, gas)
-            zero_grads = jax.tree_util.tree_map(
+            zero_grads = constrain_grads(jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32) if hasattr(p, "dtype")
-                else p, state.params)
+                else p, state.params))
             (grads, mean_loss), _ = lax.scan(
                 accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
                 (micro_batches, keys))
@@ -697,10 +717,16 @@ class DeepSpeedEngine:
 
         vg = jax.value_and_grad(scaled_loss, has_aux=True)
 
-        @jax.jit
+        grad_sh = self._grad_shardings()
+
         def grad_step(params, mb, key, scale):
             (_, raw_loss), grads = vg(params, mb, key, scale)
             return grads, raw_loss
+
+        # ZeRO-2: grads leave the jitted backward already dp-sharded.
+        grad_step = jax.jit(grad_step, out_shardings=(
+            grad_sh, NamedSharding(self.mesh, P()))) \
+            if grad_sh is not None else jax.jit(grad_step)
 
         def apply_grads(state: EngineState, grads):
             scale = state.loss_scale
@@ -740,6 +766,7 @@ class DeepSpeedEngine:
 
         self._grad_step_fn = grad_step
         self._apply_grads_fn = jax.jit(apply_grads, donate_argnums=(0,))
+        return self._grad_step_fn
 
     # ------------------------------------------------------------------ #
     # Checkpointing (reference engine.py:1472-1572, §3.5)

@@ -118,10 +118,12 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
         shared = params["shared"]
 
         # Embedding front (pre-pipeline, auto-sharded over dp/mp). Each
-        # micro-batch gets its own folded key so dropout masks decorrelate.
+        # micro-batch gets its own folded key so dropout masks decorrelate;
+        # the fold domains [T, T+M) here and [T+M, T+2M) for the head are
+        # disjoint from the in-pipeline tick keys fold_in(rng, t), t < T.
         midx = jnp.arange(M)
         x = jax.vmap(lambda tk, i: embed_fn(
-            shared, tk, jax.random.fold_in(rng, i)))(micro_tokens, midx)
+            shared, tk, jax.random.fold_in(rng, T + i)))(micro_tokens, midx)
 
         mapped = jax.shard_map(
             partial(per_stage, cdtype=x.dtype), mesh=mesh,
@@ -135,7 +137,7 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
         # AND in embed_fn; plain autodiff sums both — ReduceTiedGrads parity.
         losses = jax.vmap(
             lambda y, tg, i: head_fn(shared, y, tg, jax.random.fold_in(
-                rng, M + i)))(y_last, micro_targets, midx)
+                rng, T + M + i)))(y_last, micro_targets, midx)
         return jnp.mean(losses.astype(jnp.float32))
 
     return loss_fn

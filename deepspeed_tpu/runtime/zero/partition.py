@@ -69,6 +69,23 @@ def base_spec_leaves(opt_state: Any, params: Any, param_specs: Any):
         base_tree, is_leaf=lambda x: isinstance(x, P))
 
 
+def _leaf_sharding(leaf, base: Optional[P], mesh: Mesh, axis_size: int,
+                   axis_name: Optional[str]) -> NamedSharding:
+    """The single per-leaf dispatch shared by grads and optimizer moments —
+    one implementation so their layouts stay element-aligned by
+    construction (no resharding inside the optimizer math)."""
+    if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
+        return NamedSharding(mesh, P())
+    if base is not None:
+        spec = _layer_dp(base, leaf.shape, axis_size, axis_name) \
+            if axis_name else base
+        return NamedSharding(mesh, spec)
+    if axis_name:
+        return NamedSharding(
+            mesh, _leaf_spec(leaf.shape, axis_size, axis_name))
+    return NamedSharding(mesh, P())
+
+
 def zero_shardings(opt_state: Any, mesh: Mesh, axis_name: Optional[str],
                    params: Any = None, param_specs: Any = None) -> Any:
     """NamedShardings for an optax state pytree.
@@ -88,20 +105,38 @@ def zero_shardings(opt_state: Any, mesh: Mesh, axis_name: Optional[str],
     else:
         bases = [None] * len(leaves)
 
-    out = []
-    for leaf, base in zip(leaves, bases):
-        if not hasattr(leaf, "shape") or getattr(leaf, "ndim", 0) < 1:
-            out.append(NamedSharding(mesh, P()))
-        elif base is not None:
-            spec = _layer_dp(base, leaf.shape, axis_size, axis_name) \
-                if axis_name else base
-            out.append(NamedSharding(mesh, spec))
-        elif axis_name:
-            out.append(NamedSharding(
-                mesh, _leaf_spec(leaf.shape, axis_size, axis_name)))
-        else:
-            out.append(NamedSharding(mesh, P()))
+    out = [_leaf_sharding(leaf, base, mesh, axis_size, axis_name)
+           for leaf, base in zip(leaves, bases)]
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def grad_shardings(params: Any, mesh: Mesh, axis_name: str,
+                   param_specs: Any = None) -> Any:
+    """ZeRO-2: NamedShardings for the gradient-accumulation buffer.
+
+    The reference's stage 2 never materializes an unpartitioned gradient:
+    per-param hooks copy grads into an IPG bucket and reduce each slice to
+    its owner rank (stage2.py:613-738). The TPU equivalent is declarative —
+    constrain the accumulated grads to be dp-sharded, and XLA compiles the
+    cross-dp gradient reduction as reduce-scatter with each chip holding
+    1/dp of every gradient, which the sharded optimizer update consumes
+    in place before the updated params all-gather.
+
+    With TP (``param_specs``), dp is layered onto each leaf's first free
+    divisible dim, mirroring ``zero_shardings`` for the moments so grads,
+    moments, and updates are element-aligned (no resharding inside the
+    optimizer math).
+    """
+    axis_size = mesh.shape[axis_name]
+    if param_specs is None:
+        return jax.tree_util.tree_map(
+            lambda p: _leaf_sharding(p, None, mesh, axis_size, axis_name),
+            params)
+    # tree_map uses params' structure; the matching param_specs subtree at
+    # each param leaf is the P itself (flatten_up_to stops at leaves).
+    return jax.tree_util.tree_map(
+        lambda p, base: _leaf_sharding(p, base, mesh, axis_size, axis_name),
+        params, param_specs)
 
 
 def describe_sharding(opt_state: Any, shardings: Any) -> str:
