@@ -1,0 +1,110 @@
+"""Environment report — ``ds_report`` equivalent (reference env_report.py:23).
+
+Prints the software stack (jax/jaxlib/libtpu + friends), the accelerator
+topology visible to this process, and per-op availability of the
+deepspeed_tpu kernels/components (the analogue of the reference's
+compiled/compatible op table).
+"""
+from __future__ import annotations
+
+import importlib
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _version(mod_name: str) -> str:
+    try:
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, "__version__", "unknown")
+    except Exception:
+        return "not installed"
+
+
+def op_report(lines=None) -> list:
+    """Availability of each optional component (op_builder table parity)."""
+    out = lines if lines is not None else []
+    checks = [
+        ("flash_attention (pallas)", "deepspeed_tpu.ops.flash_attention"),
+        ("sparse_attention", "deepspeed_tpu.ops.sparse_attention"),
+        ("fused optimizers", "deepspeed_tpu.ops.optimizers"),
+        ("onebit adam", "deepspeed_tpu.ops.onebit"),
+        ("cpu adam (host offload)", "deepspeed_tpu.ops.cpu_adam"),
+        ("transformer layer", "deepspeed_tpu.models.transformer"),
+        ("pipeline engine", "deepspeed_tpu.runtime.pipe.engine"),
+        ("flops profiler", "deepspeed_tpu.profiling.flops_profiler"),
+        ("elasticity", "deepspeed_tpu.elasticity"),
+    ]
+    out.append("-" * 64)
+    out.append(f"{'op / component':<36}{'status':>10}")
+    out.append("-" * 64)
+    for label, mod in checks:
+        try:
+            importlib.import_module(mod)
+            status = GREEN_OK
+        except Exception:
+            status = RED_NO
+        out.append(f"{label:<36}{status:>10}")
+    return out
+
+
+def device_report(lines=None) -> list:
+    out = lines if lines is not None else []
+    out.append("-" * 64)
+    out.append("accelerator topology")
+    out.append("-" * 64)
+    try:
+        import jax
+        devs = jax.devices()
+        out.append(f"platform ............... {devs[0].platform}")
+        out.append(f"devices (global) ....... {jax.device_count()}")
+        out.append(f"devices (local) ........ {jax.local_device_count()}")
+        out.append(f"process count .......... {jax.process_count()}")
+        for d in devs[: min(8, len(devs))]:
+            kind = getattr(d, "device_kind", "?")
+            out.append(f"  device {d.id}: {kind}")
+        try:
+            stats = devs[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                out.append(f"hbm per chip ........... "
+                           f"{stats['bytes_limit'] / 2**30:.1f} GiB")
+        except Exception:
+            pass
+    except Exception as e:  # pragma: no cover
+        out.append(f"jax devices unavailable: {e}")
+    return out
+
+
+def software_report(lines=None) -> list:
+    out = lines if lines is not None else []
+    out.append("-" * 64)
+    out.append("software stack")
+    out.append("-" * 64)
+    out.append(f"python ................. {sys.version.split()[0]}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint",
+                "numpy", "libtpu"):
+        out.append(f"{mod:<24} {_version(mod)}")
+    try:
+        import deepspeed_tpu
+        out.append(f"{'deepspeed_tpu':<24} "
+                   f"{getattr(deepspeed_tpu, '__version__', 'dev')}")
+    except Exception:
+        pass
+    return out
+
+
+def main() -> int:
+    lines: list = []
+    lines.append("=" * 64)
+    lines.append("deepspeed_tpu environment report (ds_report)")
+    lines.append("=" * 64)
+    software_report(lines)
+    device_report(lines)
+    op_report(lines)
+    print("\n".join(lines))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,15 @@
+"""Launcher constants (parity: reference launcher/constants.py)."""
+
+PDSH_LAUNCHER = "pdsh"
+SSH_LAUNCHER = "ssh"
+
+DEFAULT_HOSTFILE = "/job/hostfile"
+DEFAULT_COORDINATOR_PORT = 29500
+
+# Env vars forwarded to remote processes when present locally (the TPU
+# analogue of the reference's NCCL/PYTHON/MV2/UCX prefix list).
+EXPORT_ENV_PREFIXES = ["TPU", "JAX", "XLA", "LIBTPU", "PYTHON", "DS_"]
+
+# A `.deepspeed_env` file in ~ or . adds KEY=VALUE exports for all nodes
+# (reference runner.py:27-28).
+DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"

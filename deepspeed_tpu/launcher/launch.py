@@ -1,0 +1,123 @@
+"""Per-node launcher: spawn and supervise this host's worker processes.
+
+Capability parity with reference ``launcher/launch.py:67`` (world-info
+decode, global-rank mapping, env plumbing, subprocess spawn, and
+kill-all-on-any-failure supervision via signal handler), with the TPU
+process model: ONE worker per host by default (JAX drives every local chip
+from a single process), ``--procs_per_node > 1`` for CPU-simulated meshes.
+
+Env contract produced here and consumed by ``parallel/comm.py:37``:
+``DS_COORDINATOR_ADDRESS`` (host:port), ``DS_NUM_PROCESSES``,
+``DS_PROCESS_ID``, plus ``DS_LOCAL_RANK`` / ``DS_NODE_RANK`` and chip
+visibility (``TPU_VISIBLE_CHIPS``) when the hostfile filtered slots.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import List
+
+from .runner import decode_world_info
+from ..utils.logging import logger
+
+
+def parse_args(args=None):
+    parser = argparse.ArgumentParser(
+        description="per-node process launcher for deepspeed_tpu")
+    parser.add_argument("--world_info", type=str, required=True,
+                        help="base64 {host: [slot,...]} map from the runner")
+    parser.add_argument("--node_rank", type=int, default=-1,
+                        help="this host's index; defaults to matching "
+                             "hostname against world_info keys")
+    parser.add_argument("--coordinator_addr", type=str, default="127.0.0.1")
+    parser.add_argument("--coordinator_port", type=int, default=29500)
+    parser.add_argument("--procs_per_node", type=int, default=1)
+    parser.add_argument("user_script", type=str)
+    parser.add_argument("user_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(args=args)
+
+
+def _infer_node_rank(world: dict) -> int:
+    import socket
+    hostname = socket.gethostname()
+    hosts = list(world.keys())
+    for cand in (hostname, hostname.split(".")[0], "localhost"):
+        if cand in hosts:
+            return hosts.index(cand)
+    raise ValueError(f"host {hostname} not found in world info {hosts}")
+
+
+def main(args=None) -> int:
+    args = parse_args(args)
+    world = decode_world_info(args.world_info)
+    node_rank = args.node_rank if args.node_rank >= 0 else _infer_node_rank(world)
+    hosts = list(world.keys())
+    assert 0 <= node_rank < len(hosts), \
+        f"node_rank {node_rank} out of range for {len(hosts)} hosts"
+    ppn = max(1, args.procs_per_node)
+    num_processes = len(hosts) * ppn
+    slots = world[hosts[node_rank]]
+
+    processes: List[subprocess.Popen] = []
+
+    def sigkill_handler(signum=None, frame=None):
+        for p in processes:
+            if p.poll() is None:
+                logger.info(f"Killing subprocess {p.pid}")
+                try:
+                    p.kill()
+                except Exception:
+                    pass
+        if signum is not None:
+            sys.exit(128 + signum)
+
+    signal.signal(signal.SIGINT, sigkill_handler)
+    signal.signal(signal.SIGTERM, sigkill_handler)
+
+    for local_rank in range(ppn):
+        env = os.environ.copy()
+        process_id = node_rank * ppn + local_rank
+        env["DS_COORDINATOR_ADDRESS"] = \
+            f"{args.coordinator_addr}:{args.coordinator_port}"
+        env["DS_NUM_PROCESSES"] = str(num_processes)
+        env["DS_PROCESS_ID"] = str(process_id)
+        env["DS_LOCAL_RANK"] = str(local_rank)
+        env["DS_NODE_RANK"] = str(node_rank)
+        # Chip visibility when the hostfile/include filtered slots
+        # (CUDA_VISIBLE_DEVICES analogue, reference launch.py:103-118).
+        env["TPU_VISIBLE_CHIPS"] = ",".join(str(s) for s in slots)
+        env["DS_LOCAL_SLOT_IDS"] = env["TPU_VISIBLE_CHIPS"]
+
+        cmd = [sys.executable, "-u", args.user_script,
+               f"--local_rank={local_rank}"] + args.user_args
+        logger.info(f"launching process {process_id}: {' '.join(cmd)}")
+        processes.append(subprocess.Popen(cmd, env=env))
+
+    # Supervise: any child failing kills the whole node's processes
+    # (reference launch.py:151-167).
+    alive = list(processes)
+    rc = 0
+    try:
+        while alive:
+            finished = [p for p in alive if p.poll() is not None]
+            for p in finished:
+                alive.remove(p)
+                if p.returncode != 0:
+                    logger.error(f"process {p.pid} exited with "
+                                 f"code {p.returncode}; killing node")
+                    rc = p.returncode
+                    sigkill_handler()
+                    alive = []
+                    break
+            time.sleep(0.1)
+    finally:
+        sigkill_handler()
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

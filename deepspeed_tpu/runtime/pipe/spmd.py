@@ -8,9 +8,15 @@ parameters per device; a ``lax.scan`` over ``M + P - 1`` ticks runs
 (stage-compute → ppermute-to-next-stage) per tick — the forward wavefront of
 the schedule. JAX autodiff through the scan + ppermute generates the reverse
 wavefront (grad ticks with ppermute in the opposite direction), i.e. the
-backward half of the schedule, with per-tick rematerialization via
-``jax.checkpoint`` bounding activation memory the way 1F1B's buffer count
-does (schedule.py:237-242).
+backward half of the schedule. Per-tick rematerialization via
+``jax.checkpoint`` keeps LAYER-INTERNAL activations bounded (one stage's
+worth per tick); the pipeline's boundary tensors — the embedded inputs and
+the banked last-stage outputs — are O(M) single hidden states
+[M, mb/dp, S, H] per device, the GPipe memory profile rather than 1F1B's
+O(P) buffer count (schedule.py:237-242). With remat that bank, not layer
+activations, dominates; an out-of-scan per-micro loss emission would
+recover O(P) at the cost of conditional collectives (the round-1 design
+that crashed XLA — see "Division of labor" below).
 
 Division of labor (the load-bearing design decision):
 - INSIDE the manual ``pipe`` region: only the uniform stage body and the

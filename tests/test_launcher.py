@@ -1,0 +1,151 @@
+"""Launcher tests — parity with reference tests/unit/test_run.py (hostfile
+and include/exclude parsing; no accelerators needed) plus what the reference
+never had: a real single-host multi-process launch smoke test with
+kill-all-on-failure supervision.
+"""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (decode_world_info,
+                                           encode_world_info, fetch_hostfile,
+                                           parse_inclusion_exclusion,
+                                           parse_resource_filter)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    def write(content):
+        p = tmp_path / "hostfile"
+        p.write_text(textwrap.dedent(content))
+        return str(p)
+    return write
+
+
+class TestHostfile:
+    def test_parse(self, hostfile):
+        p = hostfile("""\
+            worker-0 slots=4
+            worker-1 slots=4
+
+            # comment
+            worker-2 slots=8
+        """)
+        pool = fetch_hostfile(p)
+        assert pool == {"worker-0": 4, "worker-1": 4, "worker-2": 8}
+        assert list(pool.keys()) == ["worker-0", "worker-1", "worker-2"]
+
+    def test_duplicate_host_raises(self, hostfile):
+        p = hostfile("worker-0 slots=4\nworker-0 slots=2\n")
+        with pytest.raises(ValueError):
+            fetch_hostfile(p)
+
+    def test_bad_format_raises(self, hostfile):
+        with pytest.raises(ValueError):
+            fetch_hostfile(hostfile("worker-0 slots=four\n"))
+        with pytest.raises(ValueError):
+            fetch_hostfile(hostfile("worker-0\n"))
+
+    def test_missing_returns_none(self):
+        assert fetch_hostfile("/nonexistent/hostfile") is None
+
+
+class TestResourceFilter:
+    POOL = {"worker-0": 4, "worker-1": 4}
+
+    def test_include_whole_and_slots(self):
+        active = parse_inclusion_exclusion(self.POOL,
+                                           "worker-0@worker-1:0,2", "")
+        assert active == {"worker-0": [0, 1, 2, 3], "worker-1": [0, 2]}
+
+    def test_exclude_slot(self):
+        active = parse_inclusion_exclusion(self.POOL, "", "worker-1:0")
+        assert active == {"worker-0": [0, 1, 2, 3], "worker-1": [1, 2, 3]}
+
+    def test_exclude_whole_host(self):
+        active = parse_inclusion_exclusion(self.POOL, "", "worker-1")
+        assert active == {"worker-0": [0, 1, 2, 3]}
+
+    def test_mutually_exclusive(self):
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(self.POOL, "worker-0", "worker-1")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(self.POOL, "worker-9", "")
+
+    def test_unknown_slot_raises(self):
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(self.POOL, "worker-0:7", "")
+
+    def test_ordering_preserved(self):
+        active = parse_resource_filter(
+            {"a": [0, 1], "b": [0, 1], "c": [0, 1]}, include_str="c@a")
+        assert list(active.keys()) == ["a", "c"]
+
+    def test_world_info_roundtrip(self):
+        world = {"worker-0": [0, 1], "worker-1": [0]}
+        assert decode_world_info(encode_world_info(world)) == world
+
+
+class TestLaunchSmoke:
+    """Single-host multi-process launches through the real runner CLI."""
+
+    def _run_launch(self, tmp_path, script_body, procs=2, timeout=60):
+        script = tmp_path / "user_script.py"
+        script.write_text(textwrap.dedent(script_body))
+        hostfile = tmp_path / "hostfile"
+        hostfile.write_text("localhost slots=2\n")
+        env = os.environ.copy()
+        env["PYTHONPATH"] = REPO + ":" + env.get("PYTHONPATH", "")
+        env["DS_OUT_DIR"] = str(tmp_path)
+        cmd = [sys.executable, "-m", "deepspeed_tpu.launcher.runner",
+               "--hostfile", str(hostfile),
+               "--procs_per_node", str(procs),
+               "--coordinator_addr", "127.0.0.1",
+               str(script)]
+        return subprocess.run(cmd, env=env, cwd=str(tmp_path),
+                              capture_output=True, text=True, timeout=timeout)
+
+    def test_two_process_launch_env_contract(self, tmp_path):
+        """Both children run with the DS_* env contract populated."""
+        res = self._run_launch(tmp_path, """\
+            import os, sys
+            out = os.environ["DS_OUT_DIR"]
+            pid = os.environ["DS_PROCESS_ID"]
+            with open(f"{out}/proc_{pid}.txt", "w") as f:
+                f.write(":".join([
+                    os.environ["DS_COORDINATOR_ADDRESS"],
+                    os.environ["DS_NUM_PROCESSES"],
+                    os.environ["DS_LOCAL_RANK"],
+                    os.environ["DS_NODE_RANK"],
+                    os.environ["TPU_VISIBLE_CHIPS"],
+                ]))
+        """)
+        assert res.returncode == 0, res.stderr
+        got = {}
+        for pid in (0, 1):
+            f = tmp_path / f"proc_{pid}.txt"
+            assert f.exists(), (res.stdout, res.stderr)
+            got[pid] = f.read_text().split(":")
+        # coordinator addr:port shared; DS_NUM_PROCESSES=2; distinct ranks
+        assert got[0][0] == got[1][0] == "127.0.0.1"
+        assert got[0][2] == got[1][2] == "2"
+        assert {got[0][3], got[1][3]} == {"0", "1"}
+        assert got[0][5] == "0,1"  # slot visibility from the hostfile
+
+    def test_failed_child_kills_siblings(self, tmp_path):
+        """One child exiting nonzero must take the node down (reference
+        launch.py:151-167 sigkill_handler semantics)."""
+        res = self._run_launch(tmp_path, """\
+            import os, sys, time
+            if os.environ["DS_PROCESS_ID"] == "1":
+                sys.exit(3)
+            time.sleep(300)   # would hang forever if not killed
+        """, timeout=120)
+        assert res.returncode != 0
