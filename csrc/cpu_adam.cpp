@@ -1,0 +1,123 @@
+// Host-resident SIMD Adam/AdamW for ZeRO-Offload.
+//
+// TPU-native counterpart of the reference's csrc/adam/cpu_adam.cpp
+// (AVX512/AVX256 intrinsics + OpenMP tiling, Step/Step_4/Step_8 unrolls,
+// ds_adam_step / ds_adam_step_plus_copy at :602,:634). Rather than
+// hand-unrolled intrinsics bound to one ISA, the hot loop is written as a
+// restrict-qualified fused multiply-add chain under
+// `#pragma omp parallel for simd`, which gcc/clang vectorize to
+// AVX2/AVX-512 on x86 TPU-VM hosts and NEON/SVE on ARM hosts — the same
+// machine code the reference gets, portable across both host ISAs.
+//
+// The "_plus_copy" variant fuses the bf16 down-cast of the updated master
+// weights into the update loop (single pass over memory), standing in for
+// the reference's fused H2D fp16 param copy (cpu_adam.cpp:634,
+// launch_param_update): the bf16 staging buffer is what jax.device_put
+// ships to HBM, so the fp32 masters are never re-read for the cast.
+
+#include <cstdint>
+#include <cmath>
+
+extern "C" {
+
+// One Adam/AdamW step over a contiguous fp32 span.
+//
+//  params/grads/exp_avg/exp_avg_sq : length-n fp32 arrays (params, moments
+//                                    updated in place)
+//  step        : 1-based optimizer step (bias correction)
+//  grad_scale  : multiplied into every gradient read — carries the
+//                combined loss-scale inverse and clip coefficient so no
+//                separate pass over the gradients is needed
+//  adamw_mode  : 1 = decoupled weight decay (AdamW), 0 = coupled L2 folded
+//                into the gradient (classic Adam, reference FusedAdam
+//                adam_w_mode=False)
+void ds_adam_step(float* __restrict params,
+                  const float* __restrict grads,
+                  float* __restrict exp_avg,
+                  float* __restrict exp_avg_sq,
+                  int64_t n, int32_t step,
+                  float lr, float beta1, float beta2, float eps,
+                  float weight_decay, int32_t adamw_mode, float grad_scale) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float decay = weight_decay;
+
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i] * grad_scale;
+    float p = params[i];
+    if (!adamw_mode && decay != 0.0f) g += decay * p;
+    float m = exp_avg[i] * beta1 + g * omb1;
+    float v = exp_avg_sq[i] * beta2 + g * g * omb2;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    // AdamW: decoupled decay applied directly to p (p -= lr * wd * p).
+    params[i] = p - step_size * (m / denom) -
+                (adamw_mode ? lr * decay * p : 0.0f);
+  }
+}
+
+// fp32 -> bf16 with round-to-nearest-even (matching XLA's convert).
+static inline uint16_t f32_to_bf16(float f) {
+  uint32_t x;
+  __builtin_memcpy(&x, &f, 4);
+  uint32_t lsb = (x >> 16) & 1u;
+  x += 0x7fffu + lsb;
+  return (uint16_t)(x >> 16);
+}
+
+// Adam step fused with the bf16 staging copy of the updated params
+// (reference ds_adam_step_plus_copy, cpu_adam.cpp:634).
+void ds_adam_step_plus_copy(float* __restrict params,
+                            const float* __restrict grads,
+                            float* __restrict exp_avg,
+                            float* __restrict exp_avg_sq,
+                            uint16_t* __restrict params_bf16,
+                            int64_t n, int32_t step,
+                            float lr, float beta1, float beta2, float eps,
+                            float weight_decay, int32_t adamw_mode,
+                            float grad_scale) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float bc2_sqrt = std::sqrt(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float decay = weight_decay;
+
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = grads[i] * grad_scale;
+    float p = params[i];
+    if (!adamw_mode && decay != 0.0f) g += decay * p;
+    float m = exp_avg[i] * beta1 + g * omb1;
+    float v = exp_avg_sq[i] * beta2 + g * g * omb2;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    float newp = p - step_size * (m / denom) -
+                 (adamw_mode ? lr * decay * p : 0.0f);
+    params[i] = newp;
+    params_bf16[i] = f32_to_bf16(newp);
+  }
+}
+
+// L2 norm of a scaled gradient span (overflow/clip decision happens on the
+// host for offloaded steps; one pass, reduction vectorized).
+double ds_grad_norm_sq(const float* __restrict grads, int64_t n,
+                       float grad_scale) {
+  double acc = 0.0;
+#pragma omp parallel for simd reduction(+ : acc) schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double g = (double)(grads[i] * grad_scale);
+    acc += g * g;
+  }
+  return acc;
+}
+
+}  // extern "C"

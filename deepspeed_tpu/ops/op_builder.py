@@ -1,0 +1,96 @@
+"""JIT builder for the native host ops.
+
+Parity with reference ``op_builder/builder.py`` (OpBuilder.jit_load,
+builder.py:182): compile C++ sources to a shared library on first use,
+cache by source hash, load via ctypes. No nvcc/torch extension machinery —
+the native surface here is host-side (TPU kernels are Pallas, which needs
+no build step), so a plain g++ invocation suffices.
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import shutil
+import subprocess
+import tempfile
+from typing import List, Optional
+
+from ..utils.logging import logger
+
+_CSRC = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "csrc")
+_CACHE_ENV = "DS_BUILD_CACHE"
+
+
+def _cache_dir() -> str:
+    d = os.environ.get(_CACHE_ENV) or os.path.join(
+        tempfile.gettempdir(), "deepspeed_tpu_ops")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _compiler() -> Optional[str]:
+    for cc in ("g++", "clang++"):
+        if shutil.which(cc):
+            return cc
+    return None
+
+
+class OpBuilder:
+    """Compile-and-load one shared object from csrc sources."""
+
+    def __init__(self, name: str, sources: List[str],
+                 extra_flags: Optional[List[str]] = None):
+        self.name = name
+        self.sources = [s if os.path.isabs(s) else os.path.join(_CSRC, s)
+                        for s in sources]
+        self.extra_flags = extra_flags or []
+        self._lib: Optional[ctypes.CDLL] = None
+
+    def is_compatible(self) -> bool:
+        return _compiler() is not None and all(
+            os.path.isfile(s) for s in self.sources)
+
+    def _hash(self) -> str:
+        h = hashlib.sha1()
+        for s in self.sources:
+            with open(s, "rb") as f:
+                h.update(f.read())
+        h.update(" ".join(self.extra_flags).encode())
+        return h.hexdigest()[:16]
+
+    def so_path(self) -> str:
+        return os.path.join(_cache_dir(), f"{self.name}_{self._hash()}.so")
+
+    def jit_load(self) -> ctypes.CDLL:
+        """Compile if needed, then dlopen (reference builder.py:182)."""
+        if self._lib is not None:
+            return self._lib
+        cc = _compiler()
+        if cc is None:
+            raise RuntimeError(f"op '{self.name}': no C++ compiler found")
+        so = self.so_path()
+        if not os.path.isfile(so):
+            flags = ["-O3", "-shared", "-fPIC", "-std=c++17", "-fopenmp",
+                     "-march=native", "-funroll-loops"] + self.extra_flags
+            cmd = [cc] + flags + self.sources + ["-o", so + ".tmp"]
+            logger.info(f"building op '{self.name}': {' '.join(cmd)}")
+            try:
+                subprocess.run(cmd, check=True, capture_output=True, text=True)
+            except subprocess.CalledProcessError as e:
+                if "-march=native" in flags:  # unsupported on some hosts
+                    flags.remove("-march=native")
+                    cmd = [cc] + flags + self.sources + ["-o", so + ".tmp"]
+                    subprocess.run(cmd, check=True, capture_output=True,
+                                   text=True)
+                else:
+                    raise RuntimeError(
+                        f"op '{self.name}' build failed:\n{e.stderr}") from e
+            os.replace(so + ".tmp", so)
+        self._lib = ctypes.CDLL(so)
+        return self._lib
+
+
+def cpu_adam_builder() -> OpBuilder:
+    return OpBuilder("cpu_adam", ["cpu_adam.cpp"])

@@ -163,16 +163,34 @@ class DeepSpeedEngine:
         self.client_optimizer = optimizer
         self.tx = self._configure_optimizer(optimizer)
 
-        # State.
-        opt_state = self.tx.init(master_params)
+        # ZeRO-Offload: masters + moments live in host RAM, updated by the
+        # C++ SIMD Adam; the device holds ONLY compute-dtype params and
+        # zero bytes of optimizer state (stage2.py:775-873 parity).
         scaler_cfg = self._loss_scaler_config()
+        self._offload: Optional["ZeroOffloadOptimizer"] = None
+        if self.config.zero_config.cpu_offload and \
+                self.zero_optimization_stage() >= 1:
+            from .zero.offload import ZeroOffloadOptimizer
+            self._offload = ZeroOffloadOptimizer(
+                master_params, self.config.optimizer_name,
+                dict(self.config.optimizer_params or {}), self._schedule_fn,
+                self.compute_dtype,
+                gradient_clipping=self.gradient_clipping(),
+                fp16=self.config.fp16_enabled, scaler_cfg=scaler_cfg)
+            # device params = compute-dtype cast; no device moments at all
+            master_params = self._offload.master_tree()
+
+        # State.
+        opt_state = () if self._offload is not None \
+            else self.tx.init(master_params)
         self._static_loss_scale = scaler_cfg["static"]
         self._scale_window = scaler_cfg["scale_window"]
         self._min_scale = scaler_cfg["min_scale"]
         self._hysteresis = scaler_cfg["hysteresis"]
         self.state = EngineState(
             step=jnp.asarray(0, jnp.int32),
-            params=master_params,
+            params=master_params if self._offload is None
+            else _cast_floats(master_params, self.compute_dtype),
             opt_state=opt_state,
             loss_scale=jnp.asarray(scaler_cfg["init_scale"], jnp.float32),
             growth_count=jnp.asarray(0, jnp.int32),
@@ -225,6 +243,7 @@ class DeepSpeedEngine:
         self._eval_step_fn = None
         self._apply_grads_fn = None
         self._grad_step_fn = None
+        self._offload_grad_fn = None
 
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
@@ -405,6 +424,8 @@ class DeepSpeedEngine:
         return [float(self._schedule_fn(self.global_steps))]
 
     def loss_scale(self) -> float:
+        if self._offload is not None:
+            return float(self._offload.loss_scale)
         return float(jax.device_get(self.state.loss_scale))
 
     # ------------------------------------------------------------------ #
@@ -428,6 +449,71 @@ class DeepSpeedEngine:
             shuffle=route == C.ROUTE_TRAIN, drop_last=True,
             data_parallel_world_size=jax.process_count(),
             data_parallel_rank=jax.process_index())
+
+    # ------------------------------------------------------------------ #
+    # ZeRO-Offload step: device grads -> host SIMD Adam -> device params
+    # ------------------------------------------------------------------ #
+    def _build_offload_grad_fn(self):
+        """Jitted grad-accumulation pass only (no optimizer apply): returns
+        (loss-scaled summed grads, mean_loss). Grads stay dp-sharded under
+        stage 2 until the host gather."""
+        gas = self._scan_microbatches()
+        loss_fn = self.loss_fn
+        compute_dtype = self.compute_dtype
+        grad_sh = self._grad_shardings()
+
+        def constrain_grads(g):
+            return g if grad_sh is None \
+                else lax.with_sharding_constraint(g, grad_sh)
+
+        def scaled_loss(params, mb, key, scale):
+            cparams = _cast_floats(params, compute_dtype)
+            out = loss_fn(cparams, mb, key)
+            loss, _ = (out if isinstance(out, tuple) else (out, None))
+            return (loss.astype(jnp.float32) * scale) / gas, loss
+
+        grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
+
+        def grads_step(params, micro_batches, rng, step, scale):
+            rng = jax.random.fold_in(rng, step)
+
+            def accum(carry, xs):
+                g_acc, loss_acc = carry
+                mb, key = xs
+                (_, raw_loss), grads = grad_fn(params, mb, key, scale)
+                g_acc = constrain_grads(
+                    jax.tree_util.tree_map(jnp.add, g_acc, grads))
+                return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
+
+            keys = jax.random.split(rng, gas)
+            zero_grads = constrain_grads(jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32)
+                if hasattr(p, "dtype") else p, params))
+            (grads, mean_loss), _ = lax.scan(
+                accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+                (micro_batches, keys))
+            return grads, mean_loss
+
+        return jax.jit(grads_step)
+
+    def _train_batch_offload(self, micro_batches):
+        if self._offload_grad_fn is None:
+            self._offload_grad_fn = self._build_offload_grad_fn()
+        off = self._offload
+        grads, loss = self._offload_grad_fn(
+            self.state.params, micro_batches, self._base_rng,
+            jnp.asarray(self.global_steps, jnp.int32),
+            jnp.asarray(off.loss_scale, jnp.float32))
+        metrics = off.host_step(jax.device_get(grads))
+        if not metrics["overflow"]:
+            # async H2D of the updated compute-dtype params
+            new_params = off.device_params(self._state_shardings.params)
+            self.state = self.state.replace(
+                params=new_params,
+                step=jnp.asarray(off.step_count, jnp.int32))
+        self.skipped_steps = off.skipped_steps
+        metrics["loss"] = loss
+        return metrics
 
     # ------------------------------------------------------------------ #
     # The jitted train step
@@ -586,7 +672,7 @@ class DeepSpeedEngine:
         ``batch``: pytree with leading dim ``gas * micro * dp_local``; or pull
         ``gas`` micro-batches from ``data_iter`` / the engine's dataloader.
         """
-        if self._train_step_fn is None:
+        if self._train_step_fn is None and self._offload is None:
             self._train_step_fn = self._build_train_step()
 
         if batch is None:
@@ -619,8 +705,11 @@ class DeepSpeedEngine:
             else:
                 micro_batches = jax.device_put(micro_batches, shardings)
         self.tput_timer.start()
-        self.state, metrics = self._train_step_fn(
-            self.state, micro_batches, self._base_rng)
+        if self._offload is not None:
+            metrics = self._train_batch_offload(micro_batches)
+        else:
+            self.state, metrics = self._train_step_fn(
+                self.state, micro_batches, self._base_rng)
 
         self.global_steps += 1
         self.micro_steps += self.gradient_accumulation_steps()
@@ -789,9 +878,13 @@ class DeepSpeedEngine:
 
         host_state = jax.device_get(self.state)
         # Host counter may lag the device value between log boundaries.
-        self.skipped_steps = int(host_state.skipped_steps)
+        if self._offload is None:
+            self.skipped_steps = int(host_state.skipped_steps)
+        # Offload: the fp32 masters on the host ARE the canonical weights.
         model_blob = {
-            "module": jax.tree_util.tree_map(np.asarray, host_state.params),
+            "module": jax.tree_util.tree_map(np.asarray, host_state.params)
+            if self._offload is None else
+            jax.tree_util.tree_map(np.asarray, self._offload.master_tree()),
         }
         # Non-array metadata goes in a JSON sidecar: msgpack restore is
         # target-structured and would drop arbitrary client_state shapes.
@@ -806,14 +899,18 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
             meta["lr_scheduler"] = self.lr_scheduler.state_dict()
 
-        optim_blob = {
-            "opt_state": jax.tree_util.tree_map(np.asarray, host_state.opt_state),
-            "step": np.asarray(host_state.step),
-            "loss_scale": np.asarray(host_state.loss_scale),
-            "growth_count": np.asarray(host_state.growth_count),
-            "hysteresis": np.asarray(host_state.hysteresis),
-            "skipped": np.asarray(host_state.skipped_steps),
-        }
+        if self._offload is not None:
+            optim_blob = {"offload": self._offload.state_dict()}
+        else:
+            optim_blob = {
+                "opt_state": jax.tree_util.tree_map(np.asarray,
+                                                    host_state.opt_state),
+                "step": np.asarray(host_state.step),
+                "loss_scale": np.asarray(host_state.loss_scale),
+                "growth_count": np.asarray(host_state.growth_count),
+                "hysteresis": np.asarray(host_state.hysteresis),
+                "skipped": np.asarray(host_state.skipped_steps),
+            }
 
         if jax.process_index() == 0:
             with open(os.path.join(path, MODEL_FILE), "wb") as f:
@@ -846,9 +943,11 @@ class DeepSpeedEngine:
             return None, {}
 
         host_state = jax.device_get(self.state)
+        params_target = host_state.params if self._offload is None \
+            else jax.device_get(self._offload.master_tree())
         with open(model_file, "rb") as f:
             model_blob = flax_serialization.from_bytes(
-                {"module": host_state.params}, f.read())
+                {"module": params_target}, f.read())
         new_params = model_blob["module"]
         meta_file = os.path.join(path, "engine_meta.json")
         meta = {}
@@ -861,6 +960,26 @@ class DeepSpeedEngine:
         self.micro_steps = self.global_steps * self.gradient_accumulation_steps()
 
         updates: Dict[str, Any] = {"params": new_params}
+        if self._offload is not None:
+            # masters are canonical; device params re-derive from them
+            leaves = jax.tree_util.tree_leaves(new_params)
+            self._offload.masters = [
+                np.ascontiguousarray(np.asarray(l, np.float32))
+                for l in leaves]
+            if load_optimizer_states:
+                optim_file = os.path.join(path, OPTIM_FILE_FMT)
+                if os.path.isfile(optim_file):
+                    with open(optim_file, "rb") as f:
+                        blob = flax_serialization.from_bytes(
+                            {"offload": self._offload.state_dict()}, f.read())
+                    self._offload.load_state_dict(blob["offload"])
+                    self.skipped_steps = self._offload.skipped_steps
+            updates["params"] = self._offload.device_params()
+            updates["step"] = jnp.asarray(self._offload.step_count, jnp.int32)
+            self.state = self._place_state(self.state.replace(**updates))
+            log_dist(f"loaded offload checkpoint {path} at "
+                     f"global_step={self.global_steps}", ranks=[0])
+            return path, meta.get("client_state", {})
         if load_optimizer_states:
             optim_file = os.path.join(path, OPTIM_FILE_FMT)
             if os.path.isfile(optim_file):
