@@ -1,0 +1,172 @@
+"""Perf ablation harness (dev tool, not shipped API).
+
+Times one train-step variant on the real chip and prints ms/step + TFLOPs.
+Usage: python ablate.py <variant>
+variants: base | remat_none | lse_ce | chunk_ce | chunk_ce_none | dense_attn
+"""
+import dataclasses
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import (gpt2_apply, gpt2_init,
+                                       gpt2_flops_per_token)
+from deepspeed_tpu.models.transformer import dense_attention
+
+VARIANT = sys.argv[1] if len(sys.argv) > 1 else "base"
+MODEL = sys.argv[2] if len(sys.argv) > 2 else "gpt2-medium"
+MBS = int(sys.argv[3]) if len(sys.argv) > 3 else 4
+
+remat = "none" if VARIANT in ("remat_none", "chunk_ce_none") else "dots"
+cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024,
+                          remat_policy=remat, hidden_dropout=0.0,
+                          attn_dropout=0.0)
+
+attention_fn = dense_attention if VARIANT == "dense_attn" else None
+
+
+def ce_full(logits, targets):
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(nll)
+
+
+def ce_lse(logits, targets):
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(lse - tgt.astype(jnp.float32))
+
+
+# ----- chunked custom-vjp CE over hidden states (never stores [N,V]) -----
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_ce(x, wte, targets, n_chunks):
+    loss, _ = _ce_fwd_impl(x, wte, targets, n_chunks)
+    return loss
+
+
+def _ce_fwd_impl(x, wte, targets, n_chunks):
+    N, H = x.shape
+    C = N // n_chunks
+    xs = x.reshape(n_chunks, C, H)
+    ts = targets.reshape(n_chunks, C)
+
+    def body(acc, xt):
+        xc, tc = xt
+        logits = jax.lax.dot_general(xc, wte, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return acc + jnp.sum(lse - tgt), lse
+
+    total, lses = lax.scan(body, jnp.asarray(0.0, jnp.float32), (xs, ts))
+    return total / N, lses
+
+
+def _ce_vjp_fwd(x, wte, targets, n_chunks):
+    loss, lses = _ce_fwd_impl(x, wte, targets, n_chunks)
+    return loss, (x, wte, targets, lses)
+
+
+def _ce_vjp_bwd(n_chunks, res, g):
+    x, wte, targets, lses = res
+    N, H = x.shape
+    C = N // n_chunks
+    xs = x.reshape(n_chunks, C, H)
+    ts = targets.reshape(n_chunks, C)
+    gn = (g / N).astype(jnp.float32)
+
+    def body(dw_acc, xt):
+        xc, tc, lse = xt
+        logits = jax.lax.dot_general(xc, wte, (((1,), (1,)), ((), ())),
+                                     preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])               # [C, V] fp32
+        onehot = jax.nn.one_hot(tc, wte.shape[0], dtype=jnp.float32)
+        dl = (p - onehot) * gn                           # [C, V]
+        dlc = dl.astype(x.dtype)
+        dx = jax.lax.dot_general(dlc, wte, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dw = jax.lax.dot_general(dlc, xc, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dw_acc + dw, dx.astype(x.dtype)
+
+    dwte, dxs = lax.scan(body, jnp.zeros(wte.shape, jnp.float32),
+                         (xs, ts, lses))
+    return dxs.reshape(N, H), dwte.astype(wte.dtype), None
+
+
+chunked_ce.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+
+
+def make_loss(variant):
+    def loss_fn(params, batch, rng):
+        tokens, targets = batch[:, :-1], batch[:, 1:]
+        if variant.startswith("chunk_ce"):
+            B, S = tokens.shape
+            x = params["wte"].astype(cfg.dtype)[tokens] + \
+                params["wpe"].astype(cfg.dtype)[None, :S]
+            from deepspeed_tpu.models.transformer import apply_blocks, layer_norm
+            x = apply_blocks(params["blocks"], x, cfg, rng=rng,
+                             deterministic=False, attention_fn=attention_fn)
+            x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                           cfg.layer_norm_eps)
+            return chunked_ce(x.reshape(B * S, -1),
+                              params["wte"].astype(cfg.dtype),
+                              targets.reshape(-1), 16)
+        logits = gpt2_apply(params, tokens, cfg, rng=rng, deterministic=False,
+                            attention_fn=attention_fn)
+        if variant == "lse_ce":
+            return ce_lse(logits, targets)
+        return ce_full(logits, targets)
+    return loss_fn
+
+
+def main():
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+    loss_fn = make_loss(VARIANT)
+
+    def cast(p):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 else a, p)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        def scaled(p):
+            return loss_fn(cast(p), batch, rng)
+        loss, grads = jax.value_and_grad(scaled)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    S = cfg.max_seq_length
+    batch = jnp.asarray(np.random.randint(0, cfg.vocab_size,
+                                          size=(MBS, S + 1), dtype=np.int32))
+    rng = jax.random.PRNGKey(1)
+    t0 = time.perf_counter()
+    params, opt_state, loss = step(params, opt_state, batch, rng)
+    print(f"compile+1st: {time.perf_counter()-t0:.1f}s loss={float(loss):.3f}")
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, batch, rng)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / n
+    tok = MBS * S
+    tf = tok / dt * gpt2_flops_per_token(cfg, S) / 1e12
+    from bench import chip_peak_tflops
+    peak = chip_peak_tflops()
+    print(f"{VARIANT} {MODEL} mbs={MBS}: {dt*1000:.1f} ms/step, "
+          f"{tf:.1f} TFLOPs ({tf/peak*100:.1f}% peak)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,7 @@
+from .profiler import (FlopsProfiler, ProfileResult, get_model_profile,
+                       profile_fn, flops_to_string, macs_to_string,
+                       params_to_string, duration_to_string)
+
+__all__ = ["FlopsProfiler", "ProfileResult", "get_model_profile",
+           "profile_fn", "flops_to_string", "macs_to_string",
+           "params_to_string", "duration_to_string"]

@@ -1,0 +1,495 @@
+"""Flops profiler — analytic per-module FLOPs/MACs/params for JAX functions.
+
+Capability parity with the reference's hook-based profiler
+(profiling/flops_profiler/profiler.py:11-769): per-module tables, depth
+aggregation, top-k module report, and an engine hook that profiles one
+training step at a configured step index.
+
+TPU-native redesign: torch profiles by monkey-patching ``torch.nn.functional``
+and registering forward hooks per ``nn.Module`` (reference profiler.py:470-551).
+JAX functions are traced to a jaxpr, so no patching is needed — we walk the
+jaxpr once, count FLOPs per primitive (matching the reference's per-op
+formulas, profiler.py:306-456), and attribute each equation to a "module
+path" recovered from its source traceback (the chain of user function
+names, e.g. ``gpt2_apply / apply_blocks / transformer_block / dense``).
+Control-flow primitives multiply through: a ``scan`` body counts
+``length``×, a ``pallas_call`` counts ``prod(grid)``× its kernel jaxpr —
+so Pallas flash-attention kernels are costed too.
+
+Duration: one measured wall-clock execution of the jitted function is
+reported as the total; per-module durations are FLOPs-proportional
+estimates (a jaxpr has no per-module clock — unlike torch's eager hooks).
+"""
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.extend import core as jcore
+
+try:
+    from jax._src import source_info_util
+except Exception:  # pragma: no cover
+    source_info_util = None
+
+
+# --------------------------------------------------------------------- #
+# Per-primitive FLOP formulas (reference profiler.py:306-456 equivalents)
+# --------------------------------------------------------------------- #
+_ELEMENTWISE_1 = {
+    "add", "sub", "mul", "div", "max", "min", "pow", "rem", "and", "or",
+    "xor", "neg", "abs", "sign", "floor", "ceil", "round", "sqrt", "rsqrt",
+    "exp", "exp2", "expm1", "log", "log1p", "sin", "cos", "tan", "atan2",
+    "integer_pow", "square", "select_n", "clamp", "nextafter",
+}
+_ELEMENTWISE_HEAVY = {"tanh", "logistic", "erf", "erfc", "erf_inv",
+                      "cbrt", "sinh", "cosh", "asinh", "acosh", "atanh",
+                      "asin", "acos", "atan", "digamma", "lgamma"}
+# transcendental cost factor, mirroring the reference counting each
+# functional call as one "op" per output element
+_HEAVY_FACTOR = 4
+
+_ZERO_COST = {
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "convert_element_type",
+    "slice", "dynamic_slice", "dynamic_update_slice", "concatenate", "pad",
+    "rev", "gather", "scatter", "iota", "eq", "ne", "lt", "le", "gt", "ge",
+    "is_finite", "stop_gradient", "copy", "device_put", "split",
+    "bitcast_convert_type", "expand_dims", "real", "imag", "not",
+    "shift_left", "shift_right_logical", "shift_right_arithmetic",
+    "population_count", "clz", "random_bits", "random_seed", "random_wrap",
+    "random_fold_in", "threefry2x32", "partition_id", "axis_index",
+    "empty", "argmax", "argmin", "reduce_precision", "optimization_barrier",
+}
+
+_REDUCE = {"reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+           "reduce_and", "reduce_or", "reduce_xor", "cumsum", "cumprod",
+           "cummax", "cummin", "cumlogsumexp", "reduce_window_sum",
+           "reduce_window_max", "reduce_window_min", "add_any"}
+
+
+def _size(aval) -> int:
+    try:
+        return int(np.prod(aval.shape)) if aval.shape else 1
+    except Exception:
+        return 0
+
+
+def _dot_general_flops(eqn) -> Tuple[int, int]:
+    """2*M*N*K FLOPs / M*N*K MACs (reference _linear_flops_compute,
+    profiler.py:306-320)."""
+    a, b = eqn.invars[0].aval, eqn.invars[1].aval
+    (contract_a, _), (batch_a, _) = eqn.params["dimension_numbers"]
+    k = int(np.prod([a.shape[i] for i in contract_a])) or 1
+    batch = int(np.prod([a.shape[i] for i in batch_a])) or 1
+    m = int(np.prod([a.shape[i] for i in range(a.ndim)
+                     if i not in contract_a and i not in batch_a])) or 1
+    n = int(np.prod([b.shape[i] for i in range(b.ndim)
+                     if i not in eqn.params["dimension_numbers"][0][1]
+                     and i not in eqn.params["dimension_numbers"][1][1]])) or 1
+    macs = batch * m * n * k
+    return 2 * macs, macs
+
+
+def _conv_flops(eqn) -> Tuple[int, int]:
+    """output_size * kernel_size * in_channels MACs (reference
+    _conv_flops_compute, profiler.py:322-360)."""
+    rhs = eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    macs = _size(out) * int(np.prod(rhs.shape[:-1] if rhs.ndim else (1,)))
+    # rhs layout varies; approximate: total kernel elems / out_channels
+    dn = eqn.params.get("dimension_numbers")
+    try:
+        out_c = rhs.shape[dn.rhs_spec[0]]
+        macs = _size(out) * (int(np.prod(rhs.shape)) // max(out_c, 1))
+    except Exception:
+        pass
+    return 2 * macs, macs
+
+
+def eqn_flops(eqn) -> Tuple[int, int]:
+    """(flops, macs) for one jaxpr equation; sub-jaxpr prims return 0 here
+    (handled by the recursive walker)."""
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_general_flops(eqn)
+    if name == "conv_general_dilated":
+        return _conv_flops(eqn)
+    if name in _REDUCE:
+        return sum(_size(v.aval) for v in eqn.invars), 0
+    if name in _ELEMENTWISE_HEAVY:
+        return _HEAVY_FACTOR * _size(eqn.outvars[0].aval), 0
+    if name in _ELEMENTWISE_1:
+        return _size(eqn.outvars[0].aval), 0
+    return 0, 0
+
+
+def _sub_jaxprs(eqn) -> List[Tuple[Any, int]]:
+    """(jaxpr, multiplier) pairs for control-flow/call primitives."""
+    p = eqn.params
+    name = eqn.primitive.name
+    out = []
+    if name == "scan":
+        out.append((p["jaxpr"], int(p["length"])))
+    elif name == "while":
+        # Trip count is data-dependent; count one body + one cond pass and
+        # let the caller know via module name (reference has no analogue).
+        out.append((p["body_jaxpr"], 1))
+        out.append((p["cond_jaxpr"], 1))
+    elif name == "cond":
+        # Cost of the most expensive branch.
+        branches = p.get("branches", ())
+        if branches:
+            best = max(branches, key=lambda b: _jaxpr_total(b)[0])
+            out.append((best, 1))
+    elif name in ("pjit", "jit"):
+        out.append((p["jaxpr"], 1))
+    elif name in ("custom_vjp_call", "custom_jvp_call",
+                  "custom_vjp_call_jaxpr", "custom_jvp_call_jaxpr"):
+        inner = p.get("call_jaxpr") or p.get("fun_jaxpr")
+        if inner is not None:
+            out.append((inner, 1))
+    elif name in ("remat", "checkpoint", "remat2"):
+        out.append((p["jaxpr"], 1))
+    elif name == "pallas_call":
+        grid = p.get("grid_mapping")
+        mult = 1
+        try:
+            mult = int(np.prod([int(g) for g in grid.grid])) if grid else 1
+        except Exception:
+            mult = 1
+        out.append((p["jaxpr"], mult))
+    elif name in ("closed_call", "core_call", "xla_call"):
+        out.append((p["call_jaxpr"], 1))
+    elif name == "shard_map":
+        out.append((p["jaxpr"], 1))
+    if not out:
+        # Version-robust fallback: recurse into any jaxpr-valued param of an
+        # unrecognized call-like primitive.
+        for v in p.values():
+            if isinstance(v, jcore.ClosedJaxpr) or isinstance(v, jcore.Jaxpr):
+                out.append((v, 1))
+    return out
+
+
+def _as_jaxpr(j):
+    return j.jaxpr if hasattr(j, "jaxpr") else j
+
+
+def _jaxpr_total(jaxpr) -> Tuple[int, int]:
+    """(flops, macs) of a jaxpr, recursing into sub-jaxprs."""
+    jaxpr = _as_jaxpr(jaxpr)
+    fl = mc = 0
+    for eqn in jaxpr.eqns:
+        subs = _sub_jaxprs(eqn)
+        if subs:
+            for sub, mult in subs:
+                f, m = _jaxpr_total(sub)
+                fl += f * mult
+                mc += m * mult
+        else:
+            f, m = eqn_flops(eqn)
+            fl += f
+            mc += m
+    return fl, mc
+
+
+# --------------------------------------------------------------------- #
+# Module attribution via source tracebacks
+# --------------------------------------------------------------------- #
+_SKIP_FUNCS = {"<module>", "<lambda>", "tree_map", "wrapper", "inner",
+               "reraise_with_filtered_traceback", "cache_miss", "fun",
+               "profile_fn", "profile", "get_model_profile"}
+
+
+def _module_path(eqn, max_depth: int = 12) -> Tuple[str, ...]:
+    """Outermost→innermost chain of user function names for an equation."""
+    if source_info_util is None or eqn.source_info is None:
+        return ()
+    tb = getattr(eqn.source_info, "traceback", None)
+    if tb is None:
+        return ()
+    try:
+        frames = list(source_info_util.user_frames(tb))
+    except Exception:
+        return ()
+    frames = list(reversed(frames))               # outermost first
+    # Drop the harness: everything up to (and including) the innermost frame
+    # inside this file — pytest/runpy/engine frames above profile_fn are not
+    # part of the profiled model.
+    for i in range(len(frames) - 1, -1, -1):
+        if frames[i].file_name == __file__:
+            frames = frames[i + 1:]
+            break
+    names = []
+    for f in frames:
+        fn = f.function_name.rsplit("<locals>.", 1)[-1]   # short qualname
+        if fn in _SKIP_FUNCS:
+            continue
+        names.append(fn)
+    return tuple(names[:max_depth])
+
+
+@dataclass
+class ModuleNode:
+    """One node of the per-module aggregation tree (≈ one nn.Module row in
+    the reference's printed model profile, profiler.py:174-298)."""
+    name: str
+    flops: int = 0
+    macs: int = 0
+    children: Dict[str, "ModuleNode"] = field(default_factory=dict)
+
+    def child(self, name: str) -> "ModuleNode":
+        if name not in self.children:
+            self.children[name] = ModuleNode(name)
+        return self.children[name]
+
+    def total_flops(self) -> int:
+        return self.flops + sum(c.total_flops() for c in self.children.values())
+
+    def total_macs(self) -> int:
+        return self.macs + sum(c.total_macs() for c in self.children.values())
+
+
+def _walk(jaxpr, root: ModuleNode, mult: int) -> None:
+    jaxpr = _as_jaxpr(jaxpr)
+    for eqn in jaxpr.eqns:
+        subs = _sub_jaxprs(eqn)
+        if subs:
+            for sub, m in subs:
+                _walk(sub, root, mult * m)
+            continue
+        fl, mc = eqn_flops(eqn)
+        if fl == 0 and mc == 0:
+            continue
+        node = root
+        for name in _module_path(eqn):
+            node = node.child(name)
+        node.flops += fl * mult
+        node.macs += mc * mult
+
+
+# --------------------------------------------------------------------- #
+# Public API
+# --------------------------------------------------------------------- #
+def num_to_string(num: float, precision: int = 2) -> str:
+    if num >= 1e12:
+        return f"{num / 1e12:.{precision}f} T"
+    if num >= 1e9:
+        return f"{num / 1e9:.{precision}f} G"
+    if num >= 1e6:
+        return f"{num / 1e6:.{precision}f} M"
+    if num >= 1e3:
+        return f"{num / 1e3:.{precision}f} K"
+    return f"{num:.{precision}f} "
+
+
+def params_to_string(n, units=None, precision=2):
+    return num_to_string(float(n), precision)
+
+
+def flops_to_string(f, units=None, precision=2):
+    return num_to_string(float(f), precision) + "FLOPs"
+
+
+def macs_to_string(m, units=None, precision=2):
+    return num_to_string(float(m), precision) + "MACs"
+
+
+def duration_to_string(d, units=None, precision=2):
+    if d >= 1:
+        return f"{d:.{precision}f} s"
+    if d >= 1e-3:
+        return f"{d * 1e3:.{precision}f} ms"
+    return f"{d * 1e6:.{precision}f} us"
+
+
+@dataclass
+class ProfileResult:
+    total_flops: int
+    total_macs: int
+    total_params: int
+    duration: float              # measured seconds for one execution (0 if not run)
+    tree: ModuleNode
+
+    # ---- reference-parity getters (profiler.py:105-173) ----
+    def get_total_flops(self, as_string: bool = False):
+        return flops_to_string(self.total_flops) if as_string else self.total_flops
+
+    def get_total_macs(self, as_string: bool = False):
+        return macs_to_string(self.total_macs) if as_string else self.total_macs
+
+    def get_total_params(self, as_string: bool = False):
+        return params_to_string(self.total_params) if as_string else self.total_params
+
+    def get_total_duration(self, as_string: bool = False):
+        return duration_to_string(self.duration) if as_string else self.duration
+
+    # ---- tables ----
+    def _rows(self, node: ModuleNode, depth: int, path: str,
+              max_depth: int, out: List[Tuple[str, int, int, int]]):
+        for name, c in node.children.items():
+            p = f"{path}/{name}" if path else name
+            out.append((p, depth, c.total_flops(), c.total_macs()))
+            if max_depth < 0 or depth + 1 < max_depth:
+                self._rows(c, depth + 1, p, max_depth, out)
+
+    def aggregate_by_depth(self, depth: int = -1) -> List[Tuple[str, int, int]]:
+        """Flops aggregated at tree depth (reference's depth-aggregated
+        print, profiler.py:221-268)."""
+        rows: List[Tuple[str, int, int, int]] = []
+        self._rows(self.tree, 0, "", -1, rows)
+        if depth < 0:
+            return [(p, f, m) for (p, d, f, m) in rows]
+        agg: Dict[str, Tuple[int, int]] = {}
+        for (p, d, f, m) in rows:
+            if d == depth:
+                agg[p] = (f, m)
+        return [(p, f, m) for p, (f, m) in agg.items()]
+
+    def top_modules(self, k: int = 1, depth: int = 1) -> List[Tuple[str, int, int]]:
+        rows = self.aggregate_by_depth(depth - 1 if depth > 0 else 0)
+        return sorted(rows, key=lambda r: -r[1])[:k]
+
+    def format_profile(self, module_depth: int = -1, top_modules: int = 1,
+                       detailed: bool = True) -> str:
+        lines = [
+            "-------------------------- DeepSpeed-TPU Flops Profiler "
+            "--------------------------",
+            f"params:   {params_to_string(self.total_params)}",
+            f"fwd+step flops: {flops_to_string(self.total_flops)}",
+            f"fwd+step MACs:  {macs_to_string(self.total_macs)}",
+        ]
+        if self.duration:
+            lines.append(f"measured step time: "
+                         f"{duration_to_string(self.duration)}  "
+                         f"({self.total_flops / self.duration / 1e12:.2f} "
+                         f"TFLOPS achieved)")
+        lines.append("")
+        lines.append(f"Top {top_modules} modules by FLOPs:")
+        for (p, f, m) in self.top_modules(top_modules, depth=1):
+            lines.append(f"  {p}: {flops_to_string(f)}")
+        if detailed:
+            lines.append("")
+            lines.append("Per-module profile "
+                         "(module, flops, MACs, est. duration share):")
+            rows: List[Tuple[str, int, int, int]] = []
+            self._rows(self.tree, 0, "", module_depth, rows)
+            tot = max(self.total_flops, 1)
+            for (p, d, f, m) in rows:
+                indent = "  " * (d + 1)
+                dur = ""
+                if self.duration:
+                    dur = f", ~{duration_to_string(self.duration * f / tot)}"
+                lines.append(f"{indent}{p.rsplit('/', 1)[-1]}: "
+                             f"{flops_to_string(f)}, {macs_to_string(m)}"
+                             f"{dur}  [{100.0 * f / tot:.1f}%]")
+        lines.append("-" * 82)
+        return "\n".join(lines)
+
+    def print_model_profile(self, module_depth: int = -1, top_modules: int = 1,
+                            detailed: bool = True) -> None:
+        print(self.format_profile(module_depth, top_modules, detailed))
+
+
+def _count_params(tree) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(tree)
+               if hasattr(l, "shape"))
+
+
+def profile_fn(fn: Callable, *args, params=None, run: bool = True,
+               static_argnums=()) -> ProfileResult:
+    """Profile ``fn(*args)``: analytic FLOPs/MACs from its jaxpr + one
+    measured execution (if ``run``).
+
+    ``params``: pytree counted for the params column (defaults to args[0]).
+    """
+    jaxpr = jax.make_jaxpr(fn, static_argnums=static_argnums)(*args)
+    root = ModuleNode("model")
+    _walk(jaxpr, root, 1)
+    fl, mc = root.total_flops(), root.total_macs()
+    duration = 0.0
+    if run:
+        jfn = jax.jit(fn, static_argnums=static_argnums)
+        out = jfn(*args)            # compile
+        jax.block_until_ready(out)
+        t0 = time.perf_counter()
+        out = jfn(*args)
+        jax.block_until_ready(out)
+        duration = time.perf_counter() - t0
+    p = params if params is not None else (args[0] if args else None)
+    return ProfileResult(total_flops=fl, total_macs=mc,
+                         total_params=_count_params(p) if p is not None else 0,
+                         duration=duration, tree=root)
+
+
+def get_model_profile(model_fn: Callable, args=(), kwargs=None,
+                      print_profile: bool = True, detailed: bool = True,
+                      module_depth: int = -1, top_modules: int = 1,
+                      warm_up: int = 1, as_string: bool = True,
+                      ignore_modules=None):
+    """Reference-parity convenience (profiler.py:651-769
+    ``get_model_profile``): returns (flops, macs, params) of one forward.
+
+    ``model_fn`` is any JAX-traceable callable; args/kwargs its inputs.
+    """
+    if ignore_modules:
+        import warnings
+        warnings.warn("ignore_modules is not supported by the jaxpr-walking "
+                      "profiler; counts include all modules")
+    kwargs = kwargs or {}
+    res = profile_fn(lambda *a: model_fn(*a, **kwargs), *args,
+                     run=warm_up > 0)
+    if print_profile:
+        res.print_model_profile(module_depth=module_depth,
+                                top_modules=top_modules, detailed=detailed)
+    if as_string:
+        return (res.get_total_flops(True), res.get_total_macs(True),
+                res.get_total_params(True))
+    return res.total_flops, res.total_macs, res.total_params
+
+
+class FlopsProfiler:
+    """Engine-facing profiler object (reference profiler.py:11 FlopsProfiler).
+
+    The engine calls :meth:`profile_step` once at the configured
+    ``profile_step``; it traces the engine's already-built train-step
+    function on the live batch and prints/stores the table.
+    """
+
+    def __init__(self, fn: Optional[Callable] = None, config=None):
+        self.fn = fn
+        self.config = config
+        self.result: Optional[ProfileResult] = None
+        self.started = False
+
+    def start_profile(self, ignore_list=None) -> None:
+        self.started = True
+
+    def stop_profile(self) -> None:
+        self.started = False
+
+    def reset_profile(self) -> None:
+        self.result = None
+
+    def end_profile(self) -> None:
+        self.stop_profile()
+        self.reset_profile()
+
+    def profile(self, fn: Callable, *args, params=None) -> ProfileResult:
+        self.result = profile_fn(fn, *args, params=params)
+        return self.result
+
+    def print_model_profile(self, profile_step=None, module_depth=-1,
+                            top_modules=1, detailed=True, output_file=None):
+        if self.result is None:
+            return
+        text = self.result.format_profile(module_depth, top_modules, detailed)
+        if output_file:
+            with open(output_file, "w") as f:
+                f.write(text)
+        else:
+            print(text)

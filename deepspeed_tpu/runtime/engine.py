@@ -226,6 +226,14 @@ class DeepSpeedEngine:
                 theta=self.config.pld_config.theta,
                 gamma=self.config.pld_config.gamma)
 
+        # Flops profiler (reference engine.py:801-824 auto-run window):
+        # profiled once, analytically, at the configured global step.
+        self.flops_profiler = None
+        if self.config.flops_profiler_config.enabled:
+            from ..profiling.flops_profiler import FlopsProfiler
+            self.flops_profiler = FlopsProfiler(
+                config=self.config.flops_profiler_config)
+
         # Observability.
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -704,6 +712,10 @@ class DeepSpeedEngine:
                     micro_batches, shardings)
             else:
                 micro_batches = jax.device_put(micro_batches, shardings)
+        if (self.flops_profiler is not None and
+                self.global_steps == self.config.flops_profiler_config.profile_step):
+            self._run_flops_profiler(micro_batches)
+
         self.tput_timer.start()
         if self._offload is not None:
             metrics = self._train_batch_offload(micro_batches)
@@ -730,6 +742,32 @@ class DeepSpeedEngine:
             self._eval_step_fn = self._build_eval_step()
         rng = rng if rng is not None else self._next_rng()
         return self._eval_step_fn(self.state.params, batch, rng)
+
+    def _run_flops_profiler(self, micro_batches) -> None:
+        """Trace the full train step and print the per-module FLOPs table
+        (reference engine.py:801-824 runs its hook profiler over one forward
+        at flops_profiler.profile_step; here the jaxpr walk covers
+        forward+backward+optimizer in one analytic pass, no monkey-patching)."""
+        from ..profiling.flops_profiler import profile_fn
+        cfg = self.config.flops_profiler_config
+        step_fn = self._train_step_fn
+        if step_fn is None:     # offload path: profile the grad function
+            if self._offload_grad_fn is None:
+                self._offload_grad_fn = self._build_offload_grad_fn()
+            res = profile_fn(
+                self._offload_grad_fn, self.state.params, micro_batches,
+                self._base_rng, jnp.asarray(self.global_steps, jnp.int32),
+                jnp.asarray(self._offload.loss_scale, jnp.float32),
+                params=self.state.params, run=False)
+        else:
+            res = profile_fn(step_fn, self.state, micro_batches,
+                             self._base_rng, params=self.state.params,
+                             run=False)
+        self.flops_profiler.result = res
+        if jax.process_index() == 0:
+            self.flops_profiler.print_model_profile(
+                module_depth=cfg.module_depth, top_modules=cfg.top_modules,
+                detailed=cfg.detailed)
 
     def _maybe_log(self, metrics) -> None:
         """Log at steps_per_print boundaries ONLY — any device_get here is a
