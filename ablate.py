@@ -25,9 +25,12 @@ MODEL = sys.argv[2] if len(sys.argv) > 2 else "gpt2-medium"
 MBS = int(sys.argv[3]) if len(sys.argv) > 3 else 4
 
 remat = "none" if VARIANT in ("remat_none", "chunk_ce_none") else "dots"
+if VARIANT.endswith("_full"):
+    remat = "full"
 cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024,
                           remat_policy=remat, hidden_dropout=0.0,
-                          attn_dropout=0.0)
+                          attn_dropout=0.0,
+                          scan_layers="unroll" not in VARIANT)
 
 attention_fn = dense_attention if VARIANT == "dense_attn" else None
 
@@ -45,64 +48,7 @@ def ce_lse(logits, targets):
     return jnp.mean(lse - tgt.astype(jnp.float32))
 
 
-# ----- chunked custom-vjp CE over hidden states (never stores [N,V]) -----
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
-def chunked_ce(x, wte, targets, n_chunks):
-    loss, _ = _ce_fwd_impl(x, wte, targets, n_chunks)
-    return loss
-
-
-def _ce_fwd_impl(x, wte, targets, n_chunks):
-    N, H = x.shape
-    C = N // n_chunks
-    xs = x.reshape(n_chunks, C, H)
-    ts = targets.reshape(n_chunks, C)
-
-    def body(acc, xt):
-        xc, tc = xt
-        logits = jax.lax.dot_general(xc, wte, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-        lse = jax.nn.logsumexp(logits, axis=-1)
-        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
-        return acc + jnp.sum(lse - tgt), lse
-
-    total, lses = lax.scan(body, jnp.asarray(0.0, jnp.float32), (xs, ts))
-    return total / N, lses
-
-
-def _ce_vjp_fwd(x, wte, targets, n_chunks):
-    loss, lses = _ce_fwd_impl(x, wte, targets, n_chunks)
-    return loss, (x, wte, targets, lses)
-
-
-def _ce_vjp_bwd(n_chunks, res, g):
-    x, wte, targets, lses = res
-    N, H = x.shape
-    C = N // n_chunks
-    xs = x.reshape(n_chunks, C, H)
-    ts = targets.reshape(n_chunks, C)
-    gn = (g / N).astype(jnp.float32)
-
-    def body(dw_acc, xt):
-        xc, tc, lse = xt
-        logits = jax.lax.dot_general(xc, wte, (((1,), (1,)), ((), ())),
-                                     preferred_element_type=jnp.float32)
-        p = jnp.exp(logits - lse[:, None])               # [C, V] fp32
-        onehot = jax.nn.one_hot(tc, wte.shape[0], dtype=jnp.float32)
-        dl = (p - onehot) * gn                           # [C, V]
-        dlc = dl.astype(x.dtype)
-        dx = jax.lax.dot_general(dlc, wte, (((1,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        dw = jax.lax.dot_general(dlc, xc, (((0,), (0,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        return dw_acc + dw, dx.astype(x.dtype)
-
-    dwte, dxs = lax.scan(body, jnp.zeros(wte.shape, jnp.float32),
-                         (xs, ts, lses))
-    return dxs.reshape(N, H), dwte.astype(wte.dtype), None
-
-
-chunked_ce.defvjp(_ce_vjp_fwd, _ce_vjp_bwd)
+from deepspeed_tpu.ops.cross_entropy import chunked_softmax_xent
 
 
 def make_loss(variant):
@@ -117,9 +63,9 @@ def make_loss(variant):
                              deterministic=False, attention_fn=attention_fn)
             x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                            cfg.layer_norm_eps)
-            return chunked_ce(x.reshape(B * S, -1),
-                              params["wte"].astype(cfg.dtype),
-                              targets.reshape(-1), 16)
+            return chunked_softmax_xent(x.reshape(B * S, -1),
+                                        params["wte"].astype(cfg.dtype),
+                                        targets.reshape(-1), 4)
         logits = gpt2_apply(params, tokens, cfg, rng=rng, deterministic=False,
                             attention_fn=attention_fn)
         if variant == "lse_ce":

@@ -74,17 +74,25 @@ def gpt2_param_shardings(cfg: GPT2Config, mp_axis: str = "model") -> Dict[str, A
     }
 
 
-def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
-               rng: Optional[jax.Array] = None, deterministic: bool = True,
-               attention_fn=None) -> jnp.ndarray:
-    """tokens [B, S] int32 → logits [B, S, V]."""
+def gpt2_hidden(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
+                rng: Optional[jax.Array] = None, deterministic: bool = True,
+                attention_fn=None) -> jnp.ndarray:
+    """tokens [B, S] int32 → final hidden states [B, S, H] (post ln_f)."""
     B, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens] + \
         params["wpe"].astype(cfg.dtype)[None, :S]
     x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
                      deterministic=deterministic, attention_fn=attention_fn)
-    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                   cfg.layer_norm_eps)
+    return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                      cfg.layer_norm_eps)
+
+
+def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
+               rng: Optional[jax.Array] = None, deterministic: bool = True,
+               attention_fn=None) -> jnp.ndarray:
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=deterministic,
+                    attention_fn=attention_fn)
     # Tied unembedding (the reference ties via TiedLayerSpec in pipeline
     # models; here it is structural).
     logits = x @ params["wte"].astype(cfg.dtype).T
@@ -96,18 +104,24 @@ def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
 
     batch: tokens [B, S+1] (inputs are [:, :-1], targets [:, 1:]) or a
     (tokens, targets) tuple.
+
+    The CE head runs through ops.cross_entropy.chunked_softmax_xent, so the
+    [tokens, vocab] fp32 logits tensor is never materialized (chunked
+    recompute in backward — see that module's docstring).
     """
+    from ..ops.cross_entropy import chunked_softmax_xent
+
     def loss_fn(params, batch, rng):
         if isinstance(batch, (tuple, list)):
             tokens, targets = batch[0], batch[1]
         else:
             tokens, targets = batch[:, :-1], batch[:, 1:]
-        logits = gpt2_apply(params, tokens, cfg, rng=rng, deterministic=False,
-                            attention_fn=attention_fn)
-        logits = logits.astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=False,
+                        attention_fn=attention_fn)
+        B, S = tokens.shape
+        return chunked_softmax_xent(x.reshape(B * S, -1),
+                                    params["wte"].astype(cfg.dtype),
+                                    targets.reshape(-1))
     return loss_fn
 
 
@@ -119,9 +133,14 @@ def gpt2_num_params(cfg: GPT2Config) -> int:
 
 
 def gpt2_flops_per_token(cfg: GPT2Config, seq_len: Optional[int] = None) -> float:
-    """Training FLOPs/token ≈ 6·N_nonemb + attention term (PaLM appendix B
-    counting)."""
+    """Training FLOPs/token = 6·N_matmul + attention term (PaLM appendix B
+    counting). N_matmul includes the tied unembedding (V·H): its logits
+    projection is a real trained-weight matmul executed fwd+bwd every step
+    (standard MFU accounting includes the vocab projection). Excluded:
+    embedding/position lookups (gathers, ~0 FLOPs) and remat recompute
+    (not useful work)."""
     S = seq_len or cfg.max_seq_length
     H, L = cfg.hidden_size, cfg.num_layers
     n = gpt2_num_params(cfg) - cfg.vocab_size * H - cfg.max_seq_length * H
+    n += cfg.vocab_size * H    # tied unembedding matmul
     return 6.0 * n + 12.0 * L * H * S

@@ -58,6 +58,11 @@ class TransformerConfig:
     remat_policy: str = "none"
     causal: bool = False
     dtype: Any = jnp.bfloat16
+    # scan_layers=True compiles one block and lax.scans it (fast compiles,
+    # small code); False unrolls the layer loop, which lets XLA overlap
+    # weight loads with compute across layer boundaries (better step time,
+    # slower compile) — the usual TPU tradeoff.
+    scan_layers: bool = True
 
     @property
     def ffn_size(self) -> int:
@@ -272,6 +277,12 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
     if cfg.remat_policy != "none":
         block = jax.checkpoint(
             block, policy=policy, static_argnums=())
+
+    if not cfg.scan_layers:
+        for i in range(L):
+            p_i = jax.tree_util.tree_map(lambda t: t[i], stacked)
+            x = block(p_i, x, rng=keys[i] if use_rng else None)
+        return x
 
     def body(h, layer):
         p, key = layer

@@ -1,0 +1,130 @@
+"""Chunked softmax cross-entropy over a tied unembedding — the loss-head op.
+
+Replaces the naive ``log_softmax(x @ wte.T)`` head, whose fp32 logits
+[tokens, vocab] tensor (824 MB at GPT-2-large bench shapes) is pure HBM
+pressure: XLA materializes it forward AND saves it for backward. Here the
+head is a custom-VJP op that computes the loss chunk-by-chunk over tokens,
+saving only the per-token logsumexp (4 bytes/token); the backward pass
+recomputes each chunk's logits once and contracts them immediately into
+``dx`` / ``dwte``. Net cost: one extra logits matmul; net saving: the full
+logits tensor never exists. This is the same memory-for-FLOPs trade the
+reference's fused kernels make with ``gelu_checkpoint``/
+``attn_dropout_checkpoint`` (csrc/transformer/ds_transformer_cuda.cpp
+memory knobs), applied to the vocabulary projection.
+
+Chunks are unrolled (not ``lax.scan``) so XLA overlaps chunk k's backward
+matmuls with chunk k+1's recompute.
+
+All ops are plain jnp/lax, so under ``jit`` + GSPMD a vocab-sharded
+``wte`` (Megatron column-parallel logits, gpt2.py shardings) lowers to
+partial logsumexps + an all-reduce, matching the hand-written
+vocab-parallel CE loss Megatron uses.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# Target fp32-logits bytes per chunk; chunks are sized so the transient
+# [chunk, vocab] block stays comfortably in the working set.
+_CHUNK_BYTES = 128 * 1024 * 1024
+
+
+_MAX_CHUNKS = 64    # chunks are Python-unrolled; bound the traced graph
+
+
+def pick_chunks(n_tokens: int, vocab: int) -> int:
+    """Smallest divisor of n_tokens >= the memory-target chunk count,
+    bounded at _MAX_CHUNKS. Falls back to the largest divisor under the
+    bound (possibly 1 = unchunked) when n_tokens has awkward factors —
+    correctness and bounded compile time over memory optimality."""
+    total = n_tokens * vocab * 4
+    target = max(1, -(-total // _CHUNK_BYTES))
+    best = 1
+    for c in range(1, min(_MAX_CHUNKS, n_tokens) + 1):
+        if n_tokens % c == 0:
+            best = c
+            if c >= target:
+                return c
+    return best
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def chunked_softmax_xent(x: jnp.ndarray, wte: jnp.ndarray,
+                         targets: jnp.ndarray, n_chunks: int = 0) -> jnp.ndarray:
+    """Mean next-token CE of ``x @ wte.T`` vs targets.
+
+    x: [N, H] activations (compute dtype); wte: [V, H] tied embedding
+    (compute dtype); targets: [N] int. Returns scalar fp32 mean NLL.
+    """
+    loss, _ = _fwd_impl(x, wte, targets, n_chunks)
+    return loss
+
+
+def _resolve(n_chunks: int, N: int, V: int) -> int:
+    return n_chunks if n_chunks > 0 else pick_chunks(N, V)
+
+
+def _fwd_impl(x, wte, targets, n_chunks):
+    N, H = x.shape
+    V = wte.shape[0]
+    C = _resolve(n_chunks, N, V)
+    xs = x.reshape(C, N // C, H)
+    ts = targets.reshape(C, N // C)
+
+    def one(xc, tc):
+        logits = jax.lax.dot_general(
+            xc, wte, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)          # [c, V] fp32
+        lse = jax.nn.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, tc[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - tgt), lse
+
+    total = jnp.asarray(0.0, jnp.float32)
+    lses = []
+    for i in range(C):
+        s, lse = one(xs[i], ts[i])
+        total = total + s
+        lses.append(lse)
+    return total / N, jnp.stack(lses)
+
+
+def _vjp_fwd(x, wte, targets, n_chunks):
+    loss, lses = _fwd_impl(x, wte, targets, n_chunks)
+    return loss, (x, wte, targets, lses)
+
+
+def _vjp_bwd(n_chunks, res, g):
+    x, wte, targets, lses = res
+    N, H = x.shape
+    V = wte.shape[0]
+    C = _resolve(n_chunks, N, V)
+    xs = x.reshape(C, N // C, H)
+    ts = targets.reshape(C, N // C)
+    gn = (g / N).astype(jnp.float32)
+
+    def one(xc, tc, lse):
+        logits = jax.lax.dot_general(
+            xc, wte, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        p = jnp.exp(logits - lse[:, None])               # softmax [c, V]
+        dl = (p - jax.nn.one_hot(tc, V, dtype=jnp.float32)) * gn
+        dlc = dl.astype(x.dtype)
+        dx = jax.lax.dot_general(dlc, wte, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        dw = jax.lax.dot_general(dlc, xc, (((0,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        return dx.astype(x.dtype), dw
+
+    dwte = jnp.zeros(wte.shape, jnp.float32)
+    dxs = []
+    for i in range(C):
+        dx, dw = one(xs[i], ts[i], lses[i])
+        dwte = dwte + dw
+        dxs.append(dx)
+    return (jnp.stack(dxs).reshape(N, H), dwte.astype(wte.dtype), None)
+
+
+chunked_softmax_xent.defvjp(_vjp_fwd, _vjp_bwd)

@@ -44,9 +44,15 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _pick_block(s: int, target: int = 512) -> int:
-    for b in (target, 256, 128):
-        if s % b == 0:
+import os
+
+_BLOCK_TARGET = int(os.environ.get("DS_FLASH_BLOCK", "1024"))
+
+
+def _pick_block(s: int, target: int = 0) -> int:
+    target = target or _BLOCK_TARGET
+    for b in (target, 512, 256, 128):
+        if b <= s and s % b == 0:
             return b
     return s  # small sequences: single block
 
@@ -66,6 +72,44 @@ def _run_pred(causal: bool, qi, kj, bq: int, bk: int, layout_block=None):
     return pred
 
 
+def _dropout_keep(seed, bh, qi, kj, bq: int, bk: int, rate: float,
+                  transposed: bool = False):
+    """Regenerable dropout keep-mask for one (q-block, k-block) tile.
+
+    A stateless position hash (murmur3 finalizer over
+    ``seed ^ bh`` and the global (q, k) element index) rather than a
+    sequential PRNG stream: forward and both backward kernels regenerate
+    the exact same mask from the seed in whichever block orientation they
+    iterate — the TPU-native replacement for the reference's *saved*
+    dropout masks replayed in backward (ops/transformer/transformer.py:
+    330-466, csrc/transformer/dropout_kernels.cu).
+    """
+    shape = (bk, bq) if transposed else (bq, bk)
+    rows = jax.lax.broadcasted_iota(jnp.uint32, shape, 0)
+    cols = jax.lax.broadcasted_iota(jnp.uint32, shape, 1)
+    if transposed:
+        qpos = cols + jnp.uint32(qi * bq)
+        kpos = rows + jnp.uint32(kj * bk)
+    else:
+        qpos = rows + jnp.uint32(qi * bq)
+        kpos = cols + jnp.uint32(kj * bk)
+    # Element id mixed with the (seed, head) stream id; uint32 wraparound is
+    # fine (stays deterministic).
+    stream = seed.astype(jnp.uint32) ^ (bh.astype(jnp.uint32) *
+                                        jnp.uint32(0x85EBCA6B))
+    x = qpos * jnp.uint32(0x9E3779B9) + kpos
+    x = x + stream
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    # keep iff uniform[0,1) >= rate. Mosaic has no uint32->f32 cast; use the
+    # top 24 bits via int32 (exact in f32).
+    u = (x >> 8).astype(jnp.int32).astype(jnp.float32) * (1.0 / 16777216.0)
+    return u >= rate
+
+
 def _causal_mask(s, qi, kj, bq: int, bk: int, transposed: bool = False):
     if transposed:
         krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + kj * bk
@@ -80,13 +124,19 @@ def _causal_mask(s, qi, kj, bq: int, bk: int, transposed: bool = False):
 # Forward kernel
 # --------------------------------------------------------------------- #
 def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
-                has_layout: bool):
-    if has_layout:
+                has_layout: bool, dropout: float = 0.0):
+    if has_layout and dropout > 0.0:
+        (layout_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    elif has_layout:
         (layout_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
+         m_scr, l_scr, acc_scr) = refs
+    elif dropout > 0.0:
+        (seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
     else:
         q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
-    qi, kj = pl.program_id(1), pl.program_id(2)
+    bh, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(kj == 0)
@@ -114,7 +164,14 @@ def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)                   # [BQ, 1]
         p = jnp.exp(s - m_new)                            # [BQ, BK]
+        # l (the softmax normalizer) accumulates the UNdropped p: dropout
+        # applies to the normalized weights w = p/l, so dropping p before
+        # the PV matmul while normalizing by the full l is exactly
+        # w' = mask * w / keep.
         l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        if dropout > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout)), 0.0)
         pv = jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, D]
@@ -151,6 +208,17 @@ def _layout_gate(layout_ref, qi, kj):
     return jnp.sum(jnp.where(jnp.logical_and(r, c), tile, 0))
 
 
+def _seed_spec():
+    """(1,1) int32 dropout seed rides SMEM (scalar memory)."""
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec((1, 1), lambda *_: (0, 0))
+
+
+def _seed_arr(seed):
+    return jnp.asarray(seed, jnp.int32).reshape(1, 1)
+
+
 def _layout_spec(num_heads: int, role: str):
     """BlockSpec for the padded layout; bh grid index → head index."""
     if role == "fwd" or role == "dq":
@@ -161,7 +229,8 @@ def _layout_spec(num_heads: int, role: str):
                         lambda b, j, i: (b % num_heads, i // 8, j // 128))
 
 
-def _flash_fwd(q, k, v, layout, scale: float, causal: bool):
+def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
+               dropout: float = 0.0, seed=None):
     """q,k,v: [BH, S, D]; layout int32 [H, nQ, nK] or None.
     → (o [BH,S,D], lse [BH,1,S] f32)."""
     BH, S, D = q.shape
@@ -175,13 +244,17 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool):
     grid = (BH, S // bq, Sk // bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, has_layout=has_layout)
+                               bq=bq, bk=bk, has_layout=has_layout,
+                               dropout=dropout)
     in_specs = [
         pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
     ]
     args = (q, k, v)
+    if dropout > 0.0:
+        in_specs = [_seed_spec()] + in_specs
+        args = (_seed_arr(seed),) + args
     if has_layout:
         in_specs = [_layout_spec(layout.shape[0], "fwd")] + in_specs
         args = (_pad_layout(layout),) + args
@@ -211,14 +284,13 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool):
 # Backward kernels
 # --------------------------------------------------------------------- #
 def _bwd_dq_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
-                   has_layout: bool):
-    if has_layout:
-        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dq_ref, acc_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-         acc_scr) = refs
-    qi, kj = pl.program_id(1), pl.program_id(2)
+                   has_layout: bool, dropout: float = 0.0):
+    refs = list(refs)
+    layout_ref = refs.pop(0) if has_layout else None
+    seed_ref = refs.pop(0) if dropout > 0.0 else None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+     acc_scr) = refs
+    bh, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
 
     @pl.when(kj == 0)
@@ -243,6 +315,11 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BQ, BK]
+        if dropout > 0.0:
+            # d/dw of w' = mask*w/keep: route do·v^T through the regenerated
+            # mask. delta = rowsum(do*o) already equals sum_j p_j g_j.
+            keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout)), 0.0)
         ds = p * (dp - delta) * scale
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
@@ -254,14 +331,13 @@ def _bwd_dq_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
 
 
 def _bwd_dkv_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
-                    has_layout: bool):
-    if has_layout:
-        (layout_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-         dk_ref, dv_ref, dk_scr, dv_scr) = refs
-    else:
-        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
-         dk_scr, dv_scr) = refs
-    kj, qi = pl.program_id(1), pl.program_id(2)
+                    has_layout: bool, dropout: float = 0.0):
+    refs = list(refs)
+    layout_ref = refs.pop(0) if has_layout else None
+    seed_ref = refs.pop(0) if dropout > 0.0 else None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+     dk_scr, dv_scr) = refs
+    bh, kj, qi = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nq = pl.num_programs(2)
 
     @pl.when(qi == 0)
@@ -285,12 +361,21 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         if causal:
             s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
         p2 = jnp.exp(s2 - lse)                            # [BK, BQ] = p.T
+        if dropout > 0.0:
+            keep2 = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk,
+                                  dropout, transposed=True)
+            inv = 1.0 / (1.0 - dropout)
+            p2_drop = jnp.where(keep2, p2 * inv, 0.0)     # = w'.T * l ... w'
+        else:
+            p2_drop = p2
         dv_scr[:] += jax.lax.dot_general(
-            p2.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            p2_drop.astype(do.dtype), do, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp2 = jax.lax.dot_general(
             v, do, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)           # [BK, BQ] = dp.T
+        if dropout > 0.0:
+            dp2 = jnp.where(keep2, dp2 * inv, 0.0)
         ds2 = p2 * (dp2 - delta) * scale
         dk_scr[:] += jax.lax.dot_general(
             ds2.astype(q.dtype), q, (((1,), (0,)), ((), ())),
@@ -302,7 +387,8 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool):
+def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
+               dropout: float = 0.0, seed=None):
     BH, S, D = q.shape
     Sk = k.shape[1]
     has_layout = layout is not None
@@ -322,12 +408,16 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool):
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
     ]
     dq_args = (q, k, v, do, lse, delta)
+    if dropout > 0.0:
+        dq_specs = [_seed_spec()] + dq_specs
+        dq_args = (_seed_arr(seed),) + dq_args
     if has_layout:
         dq_specs = [_layout_spec(layout.shape[0], "dq")] + dq_specs
         dq_args = (_pad_layout(layout),) + dq_args
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, has_layout=has_layout),
+                          bq=bq, bk=bk, has_layout=has_layout,
+                          dropout=dropout),
         grid=(BH, S // bq, Sk // bk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
@@ -345,12 +435,16 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool):
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
     ]
     dkv_args = (q, k, v, do, lse, delta)
+    if dropout > 0.0:
+        dkv_specs = [_seed_spec()] + dkv_specs
+        dkv_args = (_seed_arr(seed),) + dkv_args
     if has_layout:
         dkv_specs = [_layout_spec(layout.shape[0], "dkv")] + dkv_specs
         dkv_args = (_pad_layout(layout),) + dkv_args
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, has_layout=has_layout),
+                          bq=bq, bk=bk, has_layout=has_layout,
+                          dropout=dropout),
         grid=(BH, Sk // bk, S // bq),
         in_specs=dkv_specs,
         out_specs=[
@@ -373,40 +467,44 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool):
 # --------------------------------------------------------------------- #
 # custom_vjp wrappers (dense/causal and block-sparse variants)
 # --------------------------------------------------------------------- #
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def _flash(q, k, v, scale: float, causal: bool):
-    o, _ = _flash_fwd(q, k, v, None, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _flash(q, k, v, seed, scale: float, causal: bool, dropout: float = 0.0):
+    o, _ = _flash_fwd(q, k, v, None, scale, causal, dropout, seed)
     return o
 
 
-def _flash_vjp_fwd(q, k, v, scale, causal):
-    o, lse = _flash_fwd(q, k, v, None, scale, causal)
-    return o, (q, k, v, o, lse)
+def _flash_vjp_fwd(q, k, v, seed, scale, causal, dropout):
+    o, lse = _flash_fwd(q, k, v, None, scale, causal, dropout, seed)
+    return o, (q, k, v, seed, o, lse)
 
 
-def _flash_vjp_bwd(scale, causal, res, do):
-    q, k, v, o, lse = res
-    return _flash_bwd(q, k, v, o, lse, do, None, scale, causal)
+def _flash_vjp_bwd(scale, causal, dropout, res, do):
+    q, k, v, seed, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, None, scale, causal,
+                            dropout, seed)
+    return dq, dk, dv, None
 
 
 _flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
-def _flash_sparse(q, k, v, layout, scale: float, causal: bool):
-    o, _ = _flash_fwd(q, k, v, layout, scale, causal)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def _flash_sparse(q, k, v, layout, seed, scale: float, causal: bool,
+                  dropout: float = 0.0):
+    o, _ = _flash_fwd(q, k, v, layout, scale, causal, dropout, seed)
     return o
 
 
-def _flash_sparse_vjp_fwd(q, k, v, layout, scale, causal):
-    o, lse = _flash_fwd(q, k, v, layout, scale, causal)
-    return o, (q, k, v, layout, o, lse)
+def _flash_sparse_vjp_fwd(q, k, v, layout, seed, scale, causal, dropout):
+    o, lse = _flash_fwd(q, k, v, layout, scale, causal, dropout, seed)
+    return o, (q, k, v, layout, seed, o, lse)
 
 
-def _flash_sparse_vjp_bwd(scale, causal, res, do):
-    q, k, v, layout, o, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, layout, scale, causal)
-    return dq, dk, dv, None
+def _flash_sparse_vjp_bwd(scale, causal, dropout, res, do):
+    q, k, v, layout, seed, o, lse = res
+    dq, dk, dv = _flash_bwd(q, k, v, o, lse, do, layout, scale, causal,
+                            dropout, seed)
+    return dq, dk, dv, None, None
 
 
 _flash_sparse.defvjp(_flash_sparse_vjp_fwd, _flash_sparse_vjp_bwd)
@@ -425,9 +523,10 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Drop-in for models.transformer.dense_attention: q,k,v [B,S,nH,dH].
 
     ``layout`` [nH, S//block, S//block] int32 enables block-sparse mode.
-    Falls back to the dense path for additive masks or attention dropout
-    (the reference keeps a non-fused path for the same cases,
-    transformer.py:153 vs the vanilla BertSelfAttention it replaces).
+    Attention dropout runs IN-KERNEL (mask regenerated in backward from the
+    seed — see _dropout_keep); only additive masks and non-128-aligned
+    sequences fall back to the dense path (the reference keeps a non-fused
+    path for the same cases, transformer.py:153).
     """
     B, S, nH, D = q.shape
     layout_block = None
@@ -441,8 +540,9 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
         # The Pallas path needs 128-aligned kernel blocks; a sparse layout
         # fixes the block to S // n_blocks, which must itself be 128-aligned.
         layout_block = S // layout.shape[-1]
-    if mask is not None or (attn_dropout > 0.0 and not deterministic) \
-            or S % 128 != 0 \
+    dropout = float(attn_dropout) if (attn_dropout > 0.0 and not deterministic
+                                      and rng is not None) else 0.0
+    if mask is not None or S % 128 != 0 \
             or (layout_block is not None and layout_block % 128 != 0):
         from ..models.transformer import dense_attention
         if layout is not None:
@@ -451,12 +551,14 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                                attn_dropout=attn_dropout, rng=rng,
                                deterministic=deterministic)
     scale = 1.0 / math.sqrt(D)
+    seed = jax.random.bits(rng, (), jnp.uint32).astype(jnp.int32) \
+        if dropout > 0.0 else jnp.zeros((), jnp.int32)
     qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
     if layout is None:
-        o = _flash(qt, kt, vt, scale, causal)
+        o = _flash(qt, kt, vt, seed, scale, causal, dropout)
     else:
         o = _flash_sparse(qt, kt, vt, jnp.asarray(layout, jnp.int32),
-                          scale, causal)
+                          seed, scale, causal, dropout)
     return o.reshape(B, nH, S, D).transpose(0, 2, 1, 3)
 
 

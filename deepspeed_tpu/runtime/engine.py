@@ -180,29 +180,41 @@ class DeepSpeedEngine:
             # device params = compute-dtype cast; no device moments at all
             master_params = self._offload.master_tree()
 
-        # State.
-        opt_state = () if self._offload is not None \
-            else self.tx.init(master_params)
+        # State. The optimizer state is *born sharded*: its structure comes
+        # from eval_shape (zero bytes), the shardings are computed from that,
+        # and tx.init runs inside a jit with out_shardings — at no point do
+        # two full copies of the moments exist (a doubled fp32 Adam state
+        # for a 774M model is 12 GB and OOMs the init on one chip).
         self._static_loss_scale = scaler_cfg["static"]
         self._scale_window = scaler_cfg["scale_window"]
         self._min_scale = scaler_cfg["min_scale"]
         self._hysteresis = scaler_cfg["hysteresis"]
-        self.state = EngineState(
-            step=jnp.asarray(0, jnp.int32),
-            params=master_params if self._offload is None
-            else _cast_floats(master_params, self.compute_dtype),
-            opt_state=opt_state,
-            loss_scale=jnp.asarray(scaler_cfg["init_scale"], jnp.float32),
-            growth_count=jnp.asarray(0, jnp.int32),
-            hysteresis=jnp.asarray(scaler_cfg["hysteresis"], jnp.int32),
-            skipped_steps=jnp.asarray(0, jnp.int32),
-        )
-
-        # Shardings: params per TP spec (replicated by default); opt state
-        # ZeRO-sharded over dp, composed with the TP spec.
+        init_scale = scaler_cfg["init_scale"]
+        hysteresis = scaler_cfg["hysteresis"]
+        device_params = master_params if self._offload is None \
+            else _cast_floats(master_params, self.compute_dtype)
+        opt_shape = () if self._offload is not None \
+            else jax.eval_shape(self.tx.init, device_params)
         self._param_specs = param_shardings
-        self._state_shardings = self._make_state_shardings()
-        self.state = self._place_state(self.state)
+        self._state_shardings = self._make_state_shardings(
+            device_params, opt_shape)
+        offload = self._offload is not None
+        tx = self.tx
+
+        def _init_state(params):
+            return EngineState(
+                step=jnp.asarray(0, jnp.int32),
+                params=params,
+                opt_state=() if offload else tx.init(params),
+                loss_scale=jnp.asarray(init_scale, jnp.float32),
+                growth_count=jnp.asarray(0, jnp.int32),
+                hysteresis=jnp.asarray(hysteresis, jnp.int32),
+                skipped_steps=jnp.asarray(0, jnp.int32),
+            )
+
+        self.state = jax.jit(
+            _init_state, out_shardings=self._state_shardings)(
+            jax.tree_util.tree_map(jnp.asarray, device_params))
 
         # Host-side counters (reference engine.py:151-158).
         self.global_steps = 0
@@ -339,9 +351,10 @@ class DeepSpeedEngine:
         return grad_shardings(self.state.params, self.mesh, DP_AXIS,
                               self._param_specs)
 
-    def _make_state_shardings(self) -> EngineState:
+    def _make_state_shardings(self, params, opt_state) -> EngineState:
         """Params per TP spec (default replicated); ZeRO stage >= 1 shards
-        optimizer state over dp, layered on top of the TP spec."""
+        optimizer state over dp, layered on top of the TP spec. ``params`` /
+        ``opt_state`` may be shape structs (only shapes are inspected)."""
         def repl(tree):
             return jax.tree_util.tree_map(
                 lambda _: NamedSharding(self.mesh, P()), tree)
@@ -350,18 +363,18 @@ class DeepSpeedEngine:
                 lambda spec: NamedSharding(self.mesh, spec),
                 self._param_specs, is_leaf=lambda x: isinstance(x, P))
         else:
-            params_sh = repl(self.state.params)
+            params_sh = repl(params)
         if self.zero_optimization_stage() >= 1 and self.dp_size > 1:
-            opt_sh = zero_shardings(self.state.opt_state, self.mesh, DP_AXIS,
-                                    params=self.state.params,
+            opt_sh = zero_shardings(opt_state, self.mesh, DP_AXIS,
+                                    params=params,
                                     param_specs=self._param_specs)
         elif self._param_specs is not None:
             # Moments follow the param TP layout; no ZeRO axis.
-            opt_sh = zero_shardings(self.state.opt_state, self.mesh, None,
-                                    params=self.state.params,
+            opt_sh = zero_shardings(opt_state, self.mesh, None,
+                                    params=params,
                                     param_specs=self._param_specs)
         else:
-            opt_sh = repl(self.state.opt_state)
+            opt_sh = repl(opt_state)
         scalar = NamedSharding(self.mesh, P())
         return EngineState(step=scalar, params=params_sh, opt_state=opt_sh,
                            loss_scale=scalar, growth_count=scalar,
@@ -528,6 +541,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     def _build_train_step(self):
         gas = self._scan_microbatches()
+        # Single-chip/single-process: the step consumes the user's flat
+        # batch directly and splits micro-batches device-side.
+        flat_batch = self.dp_size == 1 and jax.process_count() == 1
         clip = self.gradient_clipping()
         fp16 = self.config.fp16_enabled
         static_scale = self._static_loss_scale
@@ -570,30 +586,54 @@ class DeepSpeedEngine:
             # dispatch eager device ops every step).
             rng = jax.random.fold_in(rng, state.step)
             scale = state.loss_scale
-
-            def accum(carry, xs):
-                g_acc, loss_acc = carry
-                mb, key = xs
-                (_, raw_loss), grads = grad_fn(state.params, mb, key, scale)
-                g_acc = constrain_grads(
-                    jax.tree_util.tree_map(jnp.add, g_acc, grads))
-                return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
-
             keys = jax.random.split(rng, gas)
-            zero_grads = constrain_grads(jax.tree_util.tree_map(
-                lambda p: jnp.zeros(p.shape, jnp.float32) if hasattr(p, "dtype")
-                else p, state.params))
-            (grads, mean_loss), _ = lax.scan(
-                accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
-                (micro_batches, keys))
+            if flat_batch:
+                # Flat batches are split into [gas, micro, ...] HERE, inside
+                # jit — a host-side eager reshape is one dispatch round-trip
+                # per step, which stalls the async pipeline on tunneled
+                # backends.
+                micro_batches = jax.tree_util.tree_map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
+                    micro_batches)
 
-            # Unscale the loss-scaled gradients.
-            inv = 1.0 / scale
-            grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
+            if gas == 1:
+                # Fast path: no accumulation scan — saves a full zero-init +
+                # add pass over the fp32 grad tree every step.
+                mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
+                (_, raw_loss), grads = grad_fn(state.params, mb, keys[0], scale)
+                grads = constrain_grads(grads)
+                mean_loss = raw_loss.astype(jnp.float32)
+            else:
+                def accum(carry, xs):
+                    g_acc, loss_acc = carry
+                    mb, key = xs
+                    (_, raw_loss), grads = grad_fn(state.params, mb, key, scale)
+                    g_acc = constrain_grads(
+                        jax.tree_util.tree_map(jnp.add, g_acc, grads))
+                    return (g_acc,
+                            loss_acc + raw_loss.astype(jnp.float32) / gas), None
+
+                zero_grads = constrain_grads(jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(p.shape, jnp.float32)
+                    if hasattr(p, "dtype") else p, state.params))
+                (grads, mean_loss), _ = lax.scan(
+                    accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
+                    (micro_batches, keys))
+
+            # Unscale the loss-scaled gradients. Non-fp16 runs at a static
+            # scale of 1.0 — skip the full-tree multiply entirely.
+            if fp16:
+                inv = 1.0 / scale
+                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
 
             overflow = tree_has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
 
-            grad_norm = global_norm(grads)
+            if (clip and clip > 0) or fp16:
+                grad_norm = global_norm(grads)
+            else:
+                # Full-tree norm is an extra HBM pass; only pay for it when
+                # something consumes it (clipping / overflow diagnostics).
+                grad_norm = jnp.asarray(-1.0, jnp.float32)
             if clip and clip > 0:
                 grads, _ = clip_grad_norm_(grads, clip, precomputed_norm=grad_norm)
 
@@ -657,6 +697,13 @@ class DeepSpeedEngine:
     def _next_rng(self):
         return jax.random.fold_in(self._base_rng, self.global_steps)
 
+    def _check_batch_divisible(self, batch) -> None:
+        gas = self._scan_microbatches()
+        for x in jax.tree_util.tree_leaves(batch):
+            lead = getattr(x, "shape", (0,))[0] if getattr(x, "ndim", 1) else 0
+            assert lead % gas == 0, \
+                f"batch dim {lead} not divisible by grad-accum {gas}"
+
     def _stack_micro_batches(self, batch):
         """Reshape to [gas, per_micro_step, ...]. Device arrays stay on
         device (np.asarray on a jax.Array would be a synchronous D2H
@@ -697,7 +744,14 @@ class DeepSpeedEngine:
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
                 *micro)
 
-        micro_batches = self._stack_micro_batches(batch)
+        if self._offload is None and self.dp_size == 1 \
+                and jax.process_count() == 1:
+            # Flat fast path: no host-side tree ops at all; the jitted step
+            # does the micro-batch split on device.
+            self._check_batch_divisible(batch)
+            micro_batches = batch
+        else:
+            micro_batches = self._stack_micro_batches(batch)
         if self.dp_size > 1:
             # Shard the per-micro-step batch dim over dp so XLA partitions
             # the whole forward/backward data-parallel. Multi-process: each
@@ -777,10 +831,15 @@ class DeepSpeedEngine:
         if self.global_steps % max(1, self.steps_per_print()) == 0:
             m = {k: (float(jax.device_get(v)) if hasattr(v, "dtype") else v)
                  for k, v in metrics.items()}
+            if m.get("grad_norm", 0.0) < 0:
+                # Sentinel: norm computation skipped (no clipping, no fp16) —
+                # don't surface a bogus value to logs/monitors.
+                m.pop("grad_norm", None)
             self.skipped_steps = int(jax.device_get(self.state.skipped_steps))
+            gn = f"grad_norm={m['grad_norm']:.4f} " if "grad_norm" in m else ""
             log_dist(
                 f"step={self.global_steps} loss={m['loss']:.6f} "
-                f"lr={m['lr']:.3e} grad_norm={m['grad_norm']:.4f} "
+                f"lr={m['lr']:.3e} {gn}"
                 f"loss_scale={m['loss_scale']:.1f} overflow={bool(m['overflow'])}",
                 ranks=[0])
             self._monitor.write(self.global_steps, m)

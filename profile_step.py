@@ -1,0 +1,103 @@
+"""Dev tool: component-level timing of one GPT-2 train step on the real chip.
+
+Times fwd-only, fwd+bwd, and full step for a config, with dummy-loss and
+dense-attention toggles, to locate where the step time goes.
+Usage: python profile_step.py [model] [mbs] [remat]
+"""
+import dataclasses
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import (gpt2_apply, gpt2_init,
+                                       gpt2_flops_per_token)
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-medium"
+MBS = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+REMAT = sys.argv[3] if len(sys.argv) > 3 else "dots"
+
+cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024,
+                          remat_policy=REMAT, hidden_dropout=0.0,
+                          attn_dropout=0.0)
+S = cfg.max_seq_length
+
+
+def timeit(fn, *args, n=10):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n * 1000
+
+
+def ce_full(logits, targets):
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return jnp.mean(-jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def main():
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    batch = jnp.asarray(np.random.randint(0, cfg.vocab_size,
+                                          size=(MBS, S + 1), dtype=np.int32))
+    rng = jax.random.PRNGKey(1)
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params)
+
+    def loss(p, dummy=False):
+        tokens, targets = batch[:, :-1], batch[:, 1:]
+        if dummy:
+            from deepspeed_tpu.models.transformer import apply_blocks, layer_norm
+            x = p["wte"].astype(cfg.dtype)[tokens] + \
+                p["wpe"].astype(cfg.dtype)[None, :S]
+            x = apply_blocks(p["blocks"], x, cfg, rng=rng, deterministic=False)
+            x = layer_norm(x, p["ln_f_scale"], p["ln_f_bias"], cfg.layer_norm_eps)
+            return jnp.mean(x.astype(jnp.float32) ** 2)
+        logits = gpt2_apply(p, tokens, cfg, rng=rng, deterministic=False)
+        return ce_full(logits, targets)
+
+    def cast(p):
+        return jax.tree_util.tree_map(
+            lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 else a, p)
+
+    fwd = jax.jit(lambda p: loss(cast(p)))
+    fwd_dummy = jax.jit(lambda p: loss(cast(p), dummy=True))
+    grad = jax.jit(lambda p: jax.value_and_grad(lambda q: loss(cast(q)))(p))
+    grad_dummy = jax.jit(
+        lambda p: jax.value_and_grad(lambda q: loss(cast(q), dummy=True))(p))
+
+    @jax.jit
+    def opt_only(p, o, g):
+        u, o = tx.update(g, o, p)
+        return optax.apply_updates(p, u), o
+
+    tok = MBS * S
+    fl = tok * gpt2_flops_per_token(cfg, S) / 1e12
+
+    t_fwd = timeit(fwd, params)
+    t_fwdd = timeit(fwd_dummy, params)
+    _, g = grad(params)
+    t_grad = timeit(grad, params)
+    t_gradd = timeit(grad_dummy, params)
+    t_opt = timeit(opt_only, params, opt_state, g)
+
+    print(f"{MODEL} mbs={MBS} remat={REMAT}  (total train flops {fl:.1f} TF)")
+    print(f"  fwd(CE)     : {t_fwd:7.1f} ms   fwd(dummy): {t_fwdd:7.1f} ms  "
+          f"-> CE head fwd {t_fwd - t_fwdd:5.1f} ms")
+    print(f"  fwd+bwd(CE) : {t_grad:7.1f} ms   f+b(dummy): {t_gradd:7.1f} ms  "
+          f"-> CE head f+b {t_grad - t_gradd:5.1f} ms")
+    print(f"  adamw step  : {t_opt:7.1f} ms")
+    print(f"  full ~= {t_grad + t_opt:.1f} ms -> "
+          f"{fl / (t_grad + t_opt) * 1000:.1f} TFLOPs")
+
+
+if __name__ == "__main__":
+    main()
