@@ -13,7 +13,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax
 
 from deepspeed_tpu.models import GPT2_CONFIGS
 from deepspeed_tpu.models.gpt2 import (gpt2_apply, gpt2_init,

@@ -25,6 +25,22 @@ _f32p = ctypes.POINTER(ctypes.c_float)
 _u16p = ctypes.POINTER(ctypes.c_uint16)
 
 
+def host_f32(x) -> np.ndarray:
+    """Owned, writable, C-contiguous fp32 host copy of ``x``.
+
+    np.asarray of a CPU-backend jax array is a ZERO-COPY read-only view of
+    the jax buffer — handing that to the in-place SIMD kernel would mutate
+    the caller's arrays behind XLA's back. Likewise the axon backend
+    returns F-ordered views whose flat layout must not leak into kernel
+    state (flat-index pairing breaks across a serialization round-trip).
+    """
+    a = np.asarray(x, np.float32)
+    if a.base is not None or not a.flags["OWNDATA"] \
+            or not a.flags["C_CONTIGUOUS"] or not a.flags["WRITEABLE"]:
+        a = np.array(a, np.float32, order="C")
+    return a
+
+
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ds_adam_step.argtypes = [
         _f32p, _f32p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int32,
@@ -80,10 +96,10 @@ class DeepSpeedCPUAdam:
         self.adamw_mode = bool(adamw_mode)
         self.step_count = 0
         leaves, self.treedef = jax.tree_util.tree_flatten(params)
-        self.exp_avg = [np.zeros_like(np.asarray(l, np.float32))
-                        for l in leaves]
-        self.exp_avg_sq = [np.zeros_like(np.asarray(l, np.float32))
-                           for l in leaves]
+        # Plain C-ordered zeros — zeros_like would inherit the (possibly
+        # F-ordered) layout of backend views, see host_f32.
+        self.exp_avg = [np.zeros(np.shape(l), np.float32) for l in leaves]
+        self.exp_avg_sq = [np.zeros(np.shape(l), np.float32) for l in leaves]
         self._lib = _native_lib()
 
     @property
@@ -96,8 +112,8 @@ class DeepSpeedCPUAdam:
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.step_count = int(sd["step"])
-        self.exp_avg = [np.asarray(a, np.float32) for a in sd["exp_avg"]]
-        self.exp_avg_sq = [np.asarray(a, np.float32) for a in sd["exp_avg_sq"]]
+        self.exp_avg = [host_f32(a) for a in sd["exp_avg"]]
+        self.exp_avg_sq = [host_f32(a) for a in sd["exp_avg_sq"]]
 
     # ------------------------------------------------------------------ #
     def step(self, master_leaves, grad_leaves, lr: Optional[float] = None,

@@ -170,6 +170,17 @@ class DeepSpeedEngine:
         self._offload: Optional["ZeroOffloadOptimizer"] = None
         if self.config.zero_config.cpu_offload and \
                 self.zero_optimization_stage() >= 1:
+            if jax.process_count() > 1:
+                # Under stage 2 the grads are dp-sharded across processes;
+                # jax.device_get on non-addressable shards raises at runtime
+                # and each host would redundantly run full-tree Adam. The
+                # partitioned host state exists (ZeroOffloadOptimizer
+                # partition_rank/num); the per-process shard gather/assembly
+                # glue does not yet — fail loud at init, not on a pod.
+                raise NotImplementedError(
+                    "zero_optimization.cpu_offload is single-host for now: "
+                    "multi-host offload needs process-local grad-shard "
+                    "gather + partitioned device_put assembly")
             from .zero.offload import ZeroOffloadOptimizer
             self._offload = ZeroOffloadOptimizer(
                 master_params, self.config.optimizer_name,
@@ -1058,11 +1069,11 @@ class DeepSpeedEngine:
 
         updates: Dict[str, Any] = {"params": new_params}
         if self._offload is not None:
-            # masters are canonical; device params re-derive from them
-            leaves = jax.tree_util.tree_leaves(new_params)
-            self._offload.masters = [
-                np.ascontiguousarray(np.asarray(l, np.float32))
-                for l in leaves]
+            # masters are canonical; device params re-derive from them.
+            # set_masters refreshes the bf16 staging buffers — without it,
+            # device_params() at step_count>0 would serve the PRE-load
+            # staging weights on the load_optimizer_states=False path.
+            self._offload.set_masters(jax.tree_util.tree_leaves(new_params))
             if load_optimizer_states:
                 optim_file = os.path.join(path, OPTIM_FILE_FMT)
                 if os.path.isfile(optim_file):
@@ -1071,6 +1082,10 @@ class DeepSpeedEngine:
                             {"offload": self._offload.state_dict()}, f.read())
                     self._offload.load_state_dict(blob["offload"])
                     self.skipped_steps = self._offload.skipped_steps
+            if load_lr_scheduler_states and self.lr_scheduler is not None \
+                    and "lr_scheduler" in meta \
+                    and hasattr(self.lr_scheduler, "load_state_dict"):
+                self.lr_scheduler.load_state_dict(meta["lr_scheduler"])
             updates["params"] = self._offload.device_params()
             updates["step"] = jnp.asarray(self._offload.step_count, jnp.int32)
             self.state = self._place_state(self.state.replace(**updates))

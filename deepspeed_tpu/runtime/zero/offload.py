@@ -22,7 +22,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import constants as C
-from ...ops.cpu_adam import DeepSpeedCPUAdam, _f32_to_bf16_np
+from ...ops.cpu_adam import DeepSpeedCPUAdam, _f32_to_bf16_np, host_f32
 from ...utils.logging import log_dist
 
 # Optimizers that may drive offloaded state (reference zero/utils.py:41
@@ -30,13 +30,32 @@ from ...utils.logging import log_dist
 SUPPORTED = (C.ADAM_OPTIMIZER, C.ADAMW_OPTIMIZER)
 
 
+def _partition_axis(shape, num: int) -> Optional[int]:
+    """First axis divisible by ``num`` — the SAME rule zero/partition.py's
+    _leaf_spec uses for grad/moment shardings, so host shards and device
+    grad shards are element-aligned by construction."""
+    for i, d in enumerate(shape):
+        if d >= num and d % num == 0:
+            return i
+    return None
+
+
 class ZeroOffloadOptimizer:
-    """Host-side optimizer state + step for the engine's offload path."""
+    """Host-side optimizer state + step for the engine's offload path.
+
+    ``partition_rank``/``partition_num`` partition the host masters AND
+    moments across dp ranks (reference stage2.py:326-342: each rank's host
+    buffers hold only its partition): each leaf is sliced along its
+    partition axis; leaves with no divisible axis are replicated (every
+    rank applies the identical update — same result everywhere). Host RSS
+    for the sharded leaves scales as 1/partition_num.
+    """
 
     def __init__(self, master_params: Any, opt_name: str,
                  opt_params: Dict[str, Any], schedule_fn: Callable,
                  compute_dtype, gradient_clipping: float = 0.0,
-                 fp16: bool = False, scaler_cfg: Optional[Dict] = None):
+                 fp16: bool = False, scaler_cfg: Optional[Dict] = None,
+                 partition_rank: int = 0, partition_num: int = 1):
         name = (opt_name or C.ADAM_OPTIMIZER).lower()
         if name not in SUPPORTED:
             raise ValueError(
@@ -45,12 +64,20 @@ class ZeroOffloadOptimizer:
         p = dict(opt_params or {})
         adamw_mode = p.get("adam_w_mode", name == C.ADAMW_OPTIMIZER)
 
+        self.partition_rank = int(partition_rank)
+        self.partition_num = int(partition_num)
         leaves, self.treedef = jax.tree_util.tree_flatten(master_params)
-        self.masters = [np.ascontiguousarray(np.asarray(l, np.float32))
-                        for l in leaves]
+        self.full_shapes = [np.shape(l) for l in leaves]
+        self._axes = [
+            _partition_axis(s, self.partition_num)
+            if self.partition_num > 1 else None for s in self.full_shapes]
+        self.masters = [
+            host_f32(self.slice_leaf(i, np.asarray(l, np.float32)))
+            for i, l in enumerate(leaves)]
         self.shapes = [m.shape for m in self.masters]
+        local_tree = jax.tree_util.tree_unflatten(self.treedef, self.masters)
         self.opt = DeepSpeedCPUAdam(
-            master_params, lr=p.get("lr", 1e-3),
+            local_tree, lr=p.get("lr", 1e-3),
             betas=tuple(p.get("betas", (0.9, 0.999))), eps=p.get("eps", 1e-8),
             weight_decay=p.get("weight_decay", 0.0), adamw_mode=adamw_mode)
         self.schedule_fn = schedule_fn
@@ -84,7 +111,10 @@ class ZeroOffloadOptimizer:
 
     # ------------------------------------------------------------------ #
     def device_params(self, shardings=None) -> Any:
-        """Compute-dtype params for HBM (bf16 via the fused staging copy)."""
+        """Compute-dtype params for HBM (bf16 via the fused staging copy).
+        With partition_num > 1 the returned leaves are partition-local;
+        the multi-host caller owns assembling the global arrays
+        (make_array_from_process_local_data)."""
         import ml_dtypes
         if self.compute_dtype == jnp.bfloat16:
             if self._bf16_staging is not None and self.step_count > 0:
@@ -104,12 +134,30 @@ class ZeroOffloadOptimizer:
     def master_tree(self) -> Any:
         return jax.tree_util.tree_unflatten(self.treedef, self.masters)
 
+    def slice_leaf(self, i: int, leaf: np.ndarray) -> np.ndarray:
+        """Full leaf -> this rank's partition (identity when unsharded or
+        already local-shaped)."""
+        ax = self._axes[i]
+        if ax is None or leaf.shape != self.full_shapes[i]:
+            return leaf
+        d = leaf.shape[ax] // self.partition_num
+        sl = [slice(None)] * leaf.ndim
+        sl[ax] = slice(self.partition_rank * d, (self.partition_rank + 1) * d)
+        return leaf[tuple(sl)]
+
     # ------------------------------------------------------------------ #
     def host_step(self, grads: Any) -> Dict[str, float]:
-        """One optimizer step from device-computed (loss-scaled) grads."""
-        g_leaves = [np.asarray(g, np.float32)
-                    for g in jax.tree_util.tree_leaves(grads)]
+        """One optimizer step from device-computed (loss-scaled) grads.
+
+        Grad leaves may be full-shaped (sliced here to the local partition)
+        or already partition-local."""
+        g_leaves = [self.slice_leaf(i, np.asarray(g, np.float32))
+                    for i, g in enumerate(jax.tree_util.tree_leaves(grads))]
         inv_scale = 1.0 / self.loss_scale
+        # NOTE multi-rank (partition_num > 1): this norm is over the LOCAL
+        # partition + replicated leaves; before the multi-host engine glue
+        # lands, the ranks must all-reduce the squared norm here or clip
+        # coefficients diverge and replicated leaves drift apart.
         grad_norm = self.opt.grad_norm(g_leaves, inv_scale)
         overflow = self.fp16 and not np.isfinite(grad_norm)
 
@@ -164,13 +212,25 @@ class ZeroOffloadOptimizer:
 
     def load_state_dict(self, sd: Dict[str, Any]) -> None:
         self.opt.load_state_dict(sd["optimizer"])
-        self.masters = [np.ascontiguousarray(np.asarray(m, np.float32))
-                        for m in sd["masters"]]
+        self.set_masters(sd["masters"])
         self.loss_scale = float(sd.get("loss_scale", self.loss_scale))
         self.growth_count = int(sd.get("growth_count", 0))
         self.hysteresis = int(sd.get("hysteresis", self.hysteresis_init))
         self.step_count = int(sd.get("step_count", 0))
         self.skipped_steps = int(sd.get("skipped_steps", 0))
-        if self._bf16_staging is not None and self.step_count > 0:
+
+    def set_masters(self, leaves) -> None:
+        """Replace the fp32 masters (checkpoint load; full or local-shaped
+        leaves). ALWAYS goes through here so the bf16 staging buffers can
+        never serve stale weights: device_params() reads staging whenever
+        step_count > 0, including on the load_optimizer_states=False path
+        that bypasses load_state_dict."""
+        self.masters = [
+            host_f32(self.slice_leaf(i, np.asarray(m, np.float32)))
+            for i, m in enumerate(leaves)]
+        self._sync_staging()
+
+    def _sync_staging(self) -> None:
+        if self._bf16_staging is not None:
             for buf, m in zip(self._bf16_staging, self.masters):
                 buf[...] = _f32_to_bf16_np(m)

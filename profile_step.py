@@ -5,7 +5,6 @@ dense-attention toggles, to locate where the step time goes.
 Usage: python profile_step.py [model] [mbs] [remat]
 """
 import dataclasses
-import functools
 import sys
 import time
 
