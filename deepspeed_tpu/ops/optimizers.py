@@ -31,6 +31,32 @@ def _common(params: Dict[str, Any]):
     return lr, tuple(betas), eps, weight_decay
 
 
+def _scale_by_clamped_trust_ratio(min_coeff: float, max_coeff: float):
+    """optax.scale_by_trust_ratio with the reference's per-tensor clamp
+    (fused_lamb_cuda.cpp max_coeff/min_coeff)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init_fn(params):
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params):
+        if params is None:
+            raise ValueError("trust ratio requires params")
+
+        def one(u, p):
+            p_norm = jnp.linalg.norm(p.astype(jnp.float32))
+            u_norm = jnp.linalg.norm(u.astype(jnp.float32))
+            ratio = jnp.where((p_norm > 0.0) & (u_norm > 0.0),
+                              p_norm / u_norm, 1.0)
+            ratio = jnp.clip(ratio, min_coeff, max_coeff)
+            return (u.astype(jnp.float32) * ratio).astype(u.dtype)
+
+        return jax.tree_util.tree_map(one, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
 def build_optimizer(name: str, params: Dict[str, Any],
                     schedule_fn: ScheduleOrFloat = None) -> optax.GradientTransformation:
     """Build an optax transformation from a ds_config optimizer section.
@@ -62,11 +88,16 @@ def build_optimizer(name: str, params: Dict[str, Any],
 
     if name == C.LAMB_OPTIMIZER:
         # Reference FusedLamb (ops/lamb/fused_lamb.py:12): Adam-style moments
-        # + per-tensor trust ratio. optax.lamb implements the same update.
-        max_coeff = params.get("max_coeff", 10.0)
-        min_coeff = params.get("min_coeff", 0.01)
-        return optax.lamb(learning_rate, b1=betas[0], b2=betas[1], eps=eps,
-                          weight_decay=weight_decay)
+        # + per-tensor trust ratio CLAMPED to [min_coeff, max_coeff]
+        # (fused_lamb_cuda_kernel.cu). optax.lamb has no clamp, so the chain
+        # is built explicitly with a clamped trust-ratio transform.
+        max_coeff = float(params.get("max_coeff", 10.0))
+        min_coeff = float(params.get("min_coeff", 0.01))
+        return optax.chain(
+            optax.scale_by_adam(b1=betas[0], b2=betas[1], eps=eps),
+            optax.add_decayed_weights(weight_decay),
+            _scale_by_clamped_trust_ratio(min_coeff, max_coeff),
+            optax.scale_by_learning_rate(learning_rate))
 
     if name == C.SGD_OPTIMIZER:
         momentum = params.get("momentum", 0.0)

@@ -150,10 +150,9 @@ class VariableSparsityConfig(SparsityConfig):
         if horizontal_global_attention and attention != "bidirectional":
             raise ValueError("horizontal global attention requires "
                              "bidirectional attention")
-        if num_random_blocks > 0 and not different_layout_per_head:
-            # Random blocks per head only make sense with per-head layouts;
-            # the reference enforces the same.
-            raise ValueError("random blocks need different_layout_per_head")
+        # Random blocks without different_layout_per_head are valid: the
+        # layout is sampled once for head 0 and propagated to all heads
+        # (reference sparsity_config.py num_layout_heads=1 behavior).
         self.num_random_blocks = num_random_blocks
         self.local_window_blocks = local_window_blocks or [4]
         self.global_block_indices = global_block_indices or [0]

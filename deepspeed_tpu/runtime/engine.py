@@ -161,6 +161,24 @@ class DeepSpeedEngine:
 
         # Optimizer (selection matrix parity, engine.py:588-628).
         self.client_optimizer = optimizer
+        self._onebit = (optimizer is None and
+                        (self.config.optimizer_name or "").lower() ==
+                        C.ONEBIT_ADAM_OPTIMIZER)
+        if self._onebit:
+            if self.zero_optimization_stage() >= 1:
+                raise ValueError(
+                    "OnebitAdam composes with ZeRO stage 0 only (reference: "
+                    "it is an fp16-wrapper-level optimizer, not a ZeRO one)")
+            if self.config.fp16_enabled:
+                raise NotImplementedError(
+                    "OnebitAdam on TPU runs bf16/fp32 (no dynamic loss "
+                    "scale in the compressed step); use bf16")
+            if param_shardings is not None:
+                raise NotImplementedError(
+                    "OnebitAdam + tensor-parallel param_shardings: the "
+                    "compressed step runs params replicated over dp; "
+                    "combining with a TP layout would silently all-gather "
+                    "every step")
         self.tx = self._configure_optimizer(optimizer)
 
         # ZeRO-Offload: masters + moments live in host RAM, updated by the
@@ -204,19 +222,36 @@ class DeepSpeedEngine:
         hysteresis = scaler_cfg["hysteresis"]
         device_params = master_params if self._offload is None \
             else _cast_floats(master_params, self.compute_dtype)
-        opt_shape = () if self._offload is not None \
-            else jax.eval_shape(self.tx.init, device_params)
+        if self._offload is not None:
+            opt_init = None
+        elif self._onebit:
+            from ..ops.onebit import init_state as onebit_init
+            dp_ = self.dp_size
+
+            def opt_init(params):
+                # worker_error carries a leading [dp] axis (dp-sharded in
+                # _make_state_shardings): it is genuinely PER-RANK state, so
+                # declaring it replicated would save/restore only rank 0's
+                # error feedback across checkpoints.
+                st = onebit_init(params)
+                werr = jax.tree_util.tree_map(
+                    lambda p: jnp.zeros((dp_,) + p.shape, jnp.float32),
+                    params)
+                return st._replace(worker_error=werr)
+        else:
+            opt_init = self.tx.init
+        opt_shape = () if opt_init is None \
+            else jax.eval_shape(opt_init, device_params)
         self._param_specs = param_shardings
         self._state_shardings = self._make_state_shardings(
             device_params, opt_shape)
         offload = self._offload is not None
-        tx = self.tx
 
         def _init_state(params):
             return EngineState(
                 step=jnp.asarray(0, jnp.int32),
                 params=params,
-                opt_state=() if offload else tx.init(params),
+                opt_state=() if offload else opt_init(params),
                 loss_scale=jnp.asarray(init_scale, jnp.float32),
                 growth_count=jnp.asarray(0, jnp.int32),
                 hysteresis=jnp.asarray(hysteresis, jnp.int32),
@@ -375,7 +410,15 @@ class DeepSpeedEngine:
                 self._param_specs, is_leaf=lambda x: isinstance(x, P))
         else:
             params_sh = repl(params)
-        if self.zero_optimization_stage() >= 1 and self.dp_size > 1:
+        if getattr(self, "_onebit", False) and opt_state != ():
+            # m/v/server_error replicated; worker_error dp-sharded on its
+            # leading [dp] axis (per-rank error feedback).
+            opt_sh = repl(opt_state)
+            opt_sh = opt_sh._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda _: NamedSharding(self.mesh, P(DP_AXIS)),
+                    opt_sh.worker_error))
+        elif self.zero_optimization_stage() >= 1 and self.dp_size > 1:
             opt_sh = zero_shardings(opt_state, self.mesh, DP_AXIS,
                                     params=params,
                                     param_specs=self._param_specs)
@@ -550,7 +593,96 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     # The jitted train step
     # ------------------------------------------------------------------ #
+    def _build_onebit_train_step(self):
+        """1-bit Adam step: per-rank local grads inside shard_map over dp,
+        error-feedback sign-compressed momentum allreduce (ops/onebit.py;
+        reference onebit_adam.py:104-228)."""
+        from jax.experimental.shard_map import shard_map
+        from ..ops.onebit import onebit_adam_update
+        gas = self._scan_microbatches()
+        flat_batch = self.dp_size == 1 and jax.process_count() == 1
+        loss_fn = self.loss_fn
+        compute_dtype = self.compute_dtype
+        schedule_fn = self._schedule_fn
+        p = dict(self.config.optimizer_params or {})
+        b1, b2 = tuple(p.get("betas", (0.9, 0.999)))
+        eps = p.get("eps", 1e-8)
+        wd = p.get("weight_decay", 0.0)
+        freeze_step = int(p.get("freeze_step", 100000))
+        clip = self.gradient_clipping()
+        dp, mesh = self.dp_size, self.mesh
+
+        def per_rank(params, opt_state, step, micro_batches, keys):
+            # worker_error arrives [1, ...] (its dp axis split by shard_map)
+            opt_state = opt_state._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda w: w[0], opt_state.worker_error))
+            if dp > 1:
+                # Distinct dropout streams per dp rank (the SPMD path's
+                # global-batch masks).
+                rank = lax.axis_index(DP_AXIS)
+                keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
+
+            def mean_loss_fn(p):
+                def one_micro(loss_acc, xs):
+                    mb, key = xs
+                    cparams = _cast_floats(p, compute_dtype)
+                    out = loss_fn(cparams, mb, key)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return loss_acc + loss.astype(jnp.float32) / gas, None
+
+                total, _ = lax.scan(one_micro, jnp.asarray(0.0, jnp.float32),
+                                    (micro_batches, keys))
+                return total
+
+            loss_val, grads = jax.value_and_grad(mean_loss_fn)(params)
+            lr = schedule_fn(step)
+            new_params, new_opt = onebit_adam_update(
+                grads, opt_state, params, lr=lr, b1=b1, b2=b2, eps=eps,
+                weight_decay=wd, freeze_step=freeze_step,
+                axis_name=DP_AXIS if dp > 1 else None, dp=dp, clip=clip)
+            new_opt = new_opt._replace(
+                worker_error=jax.tree_util.tree_map(
+                    lambda w: w[None], new_opt.worker_error))
+            loss_out = lax.psum(loss_val, DP_AXIS) / dp if dp > 1 else loss_val
+            return new_params, new_opt, loss_out, lr
+
+        def train_step(state: EngineState, micro_batches, rng):
+            rng = jax.random.fold_in(rng, state.step)
+            keys = jax.random.split(rng, gas)
+            if flat_batch:
+                micro_batches = jax.tree_util.tree_map(
+                    lambda x: x.reshape((gas, x.shape[0] // gas) +
+                                        x.shape[1:]), micro_batches)
+            if dp > 1:
+                batch_specs = jax.tree_util.tree_map(
+                    lambda _: P(None, DP_AXIS), micro_batches)
+                from ..ops.onebit import OnebitState
+                opt_specs = OnebitState(
+                    step=P(), m=P(), v=P(), worker_error=P(DP_AXIS),
+                    server_error=P())
+                fn = shard_map(
+                    per_rank, mesh=mesh,
+                    in_specs=(P(), opt_specs, P(), batch_specs, P()),
+                    out_specs=(P(), opt_specs, P(), P()),
+                    check_rep=False)
+            else:
+                fn = per_rank
+            new_params, new_opt, loss, lr = fn(
+                state.params, state.opt_state, state.step, micro_batches,
+                keys)
+            new_state = state.replace(step=state.step + 1, params=new_params,
+                                      opt_state=new_opt)
+            metrics = {"loss": loss, "grad_norm": jnp.asarray(-1.0),
+                       "lr": lr, "loss_scale": jnp.asarray(1.0),
+                       "overflow": jnp.asarray(False)}
+            return new_state, metrics
+
+        return jax.jit(train_step, donate_argnums=(0,))
+
     def _build_train_step(self):
+        if self._onebit:
+            return self._build_onebit_train_step()
         gas = self._scan_microbatches()
         # Single-chip/single-process: the step consumes the user's flat
         # batch directly and splits micro-batches device-side.
@@ -862,6 +994,11 @@ class DeepSpeedEngine:
         """Compute loss *and* grads in one jitted pass; grads are stashed for
         backward(). One forward execution per micro-batch, unlike a literal
         forward/backward split which would run the model twice."""
+        if self._onebit:
+            raise NotImplementedError(
+                "OnebitAdam supports train_batch() only: the compressed "
+                "allreduce lives inside the fused step, which the "
+                "forward/backward/step split cannot drive")
         if self._grad_step_fn is None:
             self._build_grad_paths()
         grads, raw_loss = self._grad_step_fn(
