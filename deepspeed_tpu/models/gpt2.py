@@ -76,13 +76,14 @@ def gpt2_param_shardings(cfg: GPT2Config, mp_axis: str = "model") -> Dict[str, A
 
 def gpt2_hidden(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
                 rng: Optional[jax.Array] = None, deterministic: bool = True,
-                attention_fn=None) -> jnp.ndarray:
+                attention_fn=None, pld_theta=None) -> jnp.ndarray:
     """tokens [B, S] int32 → final hidden states [B, S, H] (post ln_f)."""
     B, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens] + \
         params["wpe"].astype(cfg.dtype)[None, :S]
     x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
-                     deterministic=deterministic, attention_fn=attention_fn)
+                     deterministic=deterministic, attention_fn=attention_fn,
+                     pld_theta=pld_theta)
     return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
                       cfg.layer_norm_eps)
 
@@ -111,13 +112,13 @@ def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
     """
     from ..ops.cross_entropy import chunked_softmax_xent
 
-    def loss_fn(params, batch, rng):
+    def loss_fn(params, batch, rng, pld_theta=None):
         if isinstance(batch, (tuple, list)):
             tokens, targets = batch[0], batch[1]
         else:
             tokens, targets = batch[:, :-1], batch[:, 1:]
         x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=False,
-                        attention_fn=attention_fn)
+                        attention_fn=attention_fn, pld_theta=pld_theta)
         B, S = tokens.shape
         return chunked_softmax_xent(x.reshape(B * S, -1),
                                     params["wte"].astype(cfg.dtype),

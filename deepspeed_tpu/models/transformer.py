@@ -261,8 +261,17 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
                  mask: Optional[jnp.ndarray] = None,
                  rng: Optional[jax.Array] = None,
                  deterministic: bool = True,
-                 attention_fn: Optional[AttentionFn] = None) -> jnp.ndarray:
-    """Run all L layers via lax.scan over the stacked leading axis."""
+                 attention_fn: Optional[AttentionFn] = None,
+                 pld_theta: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+    """Run all L layers via lax.scan over the stacked leading axis.
+
+    ``pld_theta`` (traced scalar in (0, 1]) enables progressive layer drop
+    (reference progressive_layer_drop.py:29-37 + the PLD paper's
+    depth-scaled schedule): layer l is KEPT with probability
+    ``1 - (l+1)/L * (1 - theta)`` — deeper layers drop more often — via
+    ``lax.cond``, so a dropped layer's compute is actually skipped at run
+    time, not just masked. Requires ``rng``; ignored when deterministic.
+    """
     L = stacked["ln1_scale"].shape[0]
     if rng is None:
         keys = jnp.zeros((L, 2), jnp.uint32)
@@ -278,18 +287,30 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
         block = jax.checkpoint(
             block, policy=policy, static_argnums=())
 
+    use_pld = pld_theta is not None and not deterministic and use_rng
+
+    def maybe_dropped(p, h, key, layer_idx):
+        if not use_pld:
+            return block(p, h, rng=key if use_rng else None)
+        drop_key, blk_key = jax.random.split(key)
+        keep_prob = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * \
+            (1.0 - pld_theta)
+        keep = jax.random.bernoulli(drop_key, keep_prob)
+        return lax.cond(keep, lambda hh: block(p, hh, rng=blk_key),
+                        lambda hh: hh, h)
+
     if not cfg.scan_layers:
         for i in range(L):
             p_i = jax.tree_util.tree_map(lambda t: t[i], stacked)
-            x = block(p_i, x, rng=keys[i] if use_rng else None)
+            x = maybe_dropped(p_i, x, keys[i], jnp.asarray(i))
         return x
 
     def body(h, layer):
-        p, key = layer
-        h = block(p, h, rng=key if use_rng else None)
+        p, key, idx = layer
+        h = maybe_dropped(p, h, key, idx)
         return h, None
 
-    x, _ = lax.scan(body, x, (stacked, keys))
+    x, _ = lax.scan(body, x, (stacked, keys, jnp.arange(L)))
     return x
 
 

@@ -130,3 +130,23 @@ def send_to_prev(x: Any, axis_name: str, axis_size: int) -> Any:
 
 def axis_index(axis_name: str) -> jax.Array:
     return lax.axis_index(axis_name)
+
+
+def sparse_all_reduce(dense_grads_by_rank):
+    """Host-side sparse (CSR) allreduce of row-sparse gradients.
+
+    Parity with the engine's CSR embedding-gradient allreduce (reference
+    engine.py:1197-1253: sparse grads are shipped as values+indices and
+    re-densified after the gather). Inside jit, XLA reduces dense tensors
+    over ICI and there is nothing to save; this host path is for
+    DCN-bounded exchanges (multi-slice sync, elastic state shipping) where
+    the wire volume is ``nnz_rows/vocab`` of the dense tensor.
+
+    ``dense_grads_by_rank``: list of [rows, cols] arrays (one per rank).
+    Returns (dense_sum, sparse_elements_shipped, dense_elements).
+    """
+    from ..runtime.csr_tensor import CSRTensor, all_gather_csr
+    shards = [CSRTensor.from_dense(g) for g in dense_grads_by_rank]
+    total = all_gather_csr(shards)
+    shipped = sum(s.sparse_size() for s in shards)
+    return total.to_dense(), shipped, total.dense_size

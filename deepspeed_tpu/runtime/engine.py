@@ -277,12 +277,26 @@ class DeepSpeedEngine:
             if training_data is not None else None
         self._data_iterator = None
 
-        # PLD.
+        # PLD (reference engine.py:826-827 injects theta into every
+        # forward). Detect once whether the loss_fn can consume it; every
+        # grad-computing path (train step, offload, onebit, fwd/bwd split)
+        # threads theta when it can.
         self.progressive_layer_drop = None
+        self._accepts_pld = False
         if self.config.pld_config.enabled:
             self.progressive_layer_drop = ProgressiveLayerDrop(
                 theta=self.config.pld_config.theta,
                 gamma=self.config.pld_config.gamma)
+            import inspect
+            try:
+                self._accepts_pld = "pld_theta" in \
+                    inspect.signature(self.loss_fn).parameters
+            except (TypeError, ValueError):
+                self._accepts_pld = False
+            if not self._accepts_pld:
+                logger.warning("progressive_layer_drop enabled but the "
+                               "model's loss_fn takes no pld_theta kwarg — "
+                               "layers will not drop")
 
         # Flops profiler (reference engine.py:801-824 auto-run window):
         # profiled once, analytically, at the configured global step.
@@ -536,14 +550,16 @@ class DeepSpeedEngine:
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
         grad_sh = self._grad_shardings()
+        pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
 
         def constrain_grads(g):
             return g if grad_sh is None \
                 else lax.with_sharding_constraint(g, grad_sh)
 
-        def scaled_loss(params, mb, key, scale):
+        def scaled_loss(params, mb, key, scale, theta):
             cparams = _cast_floats(params, compute_dtype)
-            out = loss_fn(cparams, mb, key)
+            out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
+                else loss_fn(cparams, mb, key)
             loss, _ = (out if isinstance(out, tuple) else (out, None))
             return (loss.astype(jnp.float32) * scale) / gas, loss
 
@@ -551,11 +567,13 @@ class DeepSpeedEngine:
 
         def grads_step(params, micro_batches, rng, step, scale):
             rng = jax.random.fold_in(rng, step)
+            theta = pld.theta_at(step.astype(jnp.float32)) \
+                if accepts_pld else None
 
             def accum(carry, xs):
                 g_acc, loss_acc = carry
                 mb, key = xs
-                (_, raw_loss), grads = grad_fn(params, mb, key, scale)
+                (_, raw_loss), grads = grad_fn(params, mb, key, scale, theta)
                 g_acc = constrain_grads(
                     jax.tree_util.tree_map(jnp.add, g_acc, grads))
                 return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
@@ -597,7 +615,7 @@ class DeepSpeedEngine:
         """1-bit Adam step: per-rank local grads inside shard_map over dp,
         error-feedback sign-compressed momentum allreduce (ops/onebit.py;
         reference onebit_adam.py:104-228)."""
-        from jax.experimental.shard_map import shard_map
+        shard_map = jax.shard_map
         from ..ops.onebit import onebit_adam_update
         gas = self._scan_microbatches()
         flat_batch = self.dp_size == 1 and jax.process_count() == 1
@@ -611,6 +629,7 @@ class DeepSpeedEngine:
         freeze_step = int(p.get("freeze_step", 100000))
         clip = self.gradient_clipping()
         dp, mesh = self.dp_size, self.mesh
+        pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
 
         def per_rank(params, opt_state, step, micro_batches, keys):
             # worker_error arrives [1, ...] (its dp axis split by shard_map)
@@ -623,11 +642,15 @@ class DeepSpeedEngine:
                 rank = lax.axis_index(DP_AXIS)
                 keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
 
+            theta = pld.theta_at(step.astype(jnp.float32)) \
+                if accepts_pld else None
+
             def mean_loss_fn(p):
                 def one_micro(loss_acc, xs):
                     mb, key = xs
                     cparams = _cast_floats(p, compute_dtype)
-                    out = loss_fn(cparams, mb, key)
+                    out = loss_fn(cparams, mb, key, pld_theta=theta) \
+                        if accepts_pld else loss_fn(cparams, mb, key)
                     loss = out[0] if isinstance(out, tuple) else out
                     return loss_acc + loss.astype(jnp.float32) / gas, None
 
@@ -665,7 +688,7 @@ class DeepSpeedEngine:
                     per_rank, mesh=mesh,
                     in_specs=(P(), opt_specs, P(), batch_specs, P()),
                     out_specs=(P(), opt_specs, P(), P()),
-                    check_rep=False)
+                    check_vma=False)
             else:
                 fn = per_rank
             new_params, new_opt, loss, lr = fn(
@@ -715,9 +738,13 @@ class DeepSpeedEngine:
                 return g
             return lax.with_sharding_constraint(g, grad_sh)
 
-        def scaled_loss(params, mb, key, scale):
+        pld = self.progressive_layer_drop
+        accepts_pld = self._accepts_pld
+
+        def scaled_loss(params, mb, key, scale, theta):
             cparams = _cast_floats(params, compute_dtype)
-            out = loss_fn(cparams, mb, key)
+            out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
+                else loss_fn(cparams, mb, key)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             # Scale for fp16 backward; divide by gas so accumulation averages.
             return (loss.astype(jnp.float32) * scale) / gas, loss
@@ -729,6 +756,8 @@ class DeepSpeedEngine:
             # dispatch eager device ops every step).
             rng = jax.random.fold_in(rng, state.step)
             scale = state.loss_scale
+            theta = pld.theta_at(state.step.astype(jnp.float32)) \
+                if accepts_pld else None
             keys = jax.random.split(rng, gas)
             if flat_batch:
                 # Flat batches are split into [gas, micro, ...] HERE, inside
@@ -743,14 +772,16 @@ class DeepSpeedEngine:
                 # Fast path: no accumulation scan — saves a full zero-init +
                 # add pass over the fp32 grad tree every step.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                (_, raw_loss), grads = grad_fn(state.params, mb, keys[0], scale)
+                (_, raw_loss), grads = grad_fn(state.params, mb, keys[0],
+                                               scale, theta)
                 grads = constrain_grads(grads)
                 mean_loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
                     g_acc, loss_acc = carry
                     mb, key = xs
-                    (_, raw_loss), grads = grad_fn(state.params, mb, key, scale)
+                    (_, raw_loss), grads = grad_fn(state.params, mb, key,
+                                                   scale, theta)
                     g_acc = constrain_grads(
                         jax.tree_util.tree_map(jnp.add, g_acc, grads))
                     return (g_acc,
@@ -1001,8 +1032,12 @@ class DeepSpeedEngine:
                 "forward/backward/step split cannot drive")
         if self._grad_step_fn is None:
             self._build_grad_paths()
+        theta = jnp.asarray(
+            self.progressive_layer_drop.theta_at(self.global_steps),
+            jnp.float32) if self._accepts_pld else None
         grads, raw_loss = self._grad_step_fn(
-            self.state.params, batch, self._next_rng(), self.state.loss_scale)
+            self.state.params, batch, self._next_rng(), self.state.loss_scale,
+            theta)
         self._stashed_grads = grads
         return raw_loss
 
@@ -1043,9 +1078,12 @@ class DeepSpeedEngine:
         scale_window, min_scale = self._scale_window, self._min_scale
         hysteresis_init = self._hysteresis
 
-        def scaled_loss(params, mb, key, scale):
+        pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
+
+        def scaled_loss(params, mb, key, scale, theta):
             cparams = _cast_floats(params, compute_dtype)
-            out = loss_fn(cparams, mb, key)
+            out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
+                else loss_fn(cparams, mb, key)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
             return (loss.astype(jnp.float32) * scale) / gas, loss
 
@@ -1053,8 +1091,8 @@ class DeepSpeedEngine:
 
         grad_sh = self._grad_shardings()
 
-        def grad_step(params, mb, key, scale):
-            (_, raw_loss), grads = vg(params, mb, key, scale)
+        def grad_step(params, mb, key, scale, theta=None):
+            (_, raw_loss), grads = vg(params, mb, key, scale, theta)
             return grads, raw_loss
 
         # ZeRO-2: grads leave the jitted backward already dp-sharded.

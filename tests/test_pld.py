@@ -1,0 +1,107 @@
+"""Progressive layer drop: theta schedule + actual stochastic layer skip.
+
+Reference: progressive_layer_drop.py:29-37 (theta(t) schedule) +
+engine.py:826-827 (state injected into every forward). The depth test
+builds blocks whose only effect is adding proj_bias=1 to the stream, so
+(output - input) counts exactly how many layers EXECUTED.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models.transformer import (TransformerConfig, apply_blocks,
+                                              init_block_params)
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+
+def _counting_blocks(L, H):
+    """Blocks where an executed layer adds exactly +1 everywhere: all
+    kernels zero, proj_bias one, dropout off."""
+    cfg = TransformerConfig(hidden_size=H, num_heads=2, num_layers=L,
+                            hidden_dropout=0.0, attn_dropout=0.0,
+                            max_seq_length=8, pre_layer_norm=True)
+    p = init_block_params(jax.random.PRNGKey(0), cfg)
+    zeros = {k: jnp.zeros_like(v) for k, v in p.items()}
+    zeros["ln1_scale"] = p["ln1_scale"]
+    zeros["ln2_scale"] = p["ln2_scale"]
+    zeros["proj_bias"] = jnp.ones_like(p["proj_bias"])
+    return zeros, cfg
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=4)
+def _depth_fn(cfg):
+    @jax.jit
+    def run(stacked, theta, rng):
+        x = jnp.zeros((1, 4, cfg.hidden_size), jnp.float32)
+        out = apply_blocks(stacked, x, cfg, rng=rng, deterministic=False,
+                           pld_theta=theta)
+        return out.mean()
+    return run
+
+
+def _depth(stacked, cfg, theta, rng):
+    run = _depth_fn(cfg)
+    return float(run(stacked, jnp.asarray(theta, jnp.float32), rng))
+
+
+def test_theta_schedule():
+    pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+    assert abs(pld.theta_at(0) - 1.0) < 1e-6
+    assert pld.theta_at(10) < pld.theta_at(1) <= 1.0
+    assert abs(pld.theta_at(10 ** 6) - 0.5) < 1e-6
+
+
+def test_theta_one_keeps_all_layers():
+    stacked, cfg = _counting_blocks(L=8, H=16)
+    for seed in range(3):
+        d = _depth(stacked, cfg, 1.0, jax.random.PRNGKey(seed))
+        assert abs(d - 8.0) < 1e-5, d
+
+
+def test_expected_depth_tracks_theta():
+    """keep_prob_l = 1 - (l+1)/L (1-theta) -> E[depth] = L - (L+1)/2 (1-theta)."""
+    stacked, cfg = _counting_blocks(L=8, H=16)
+    for theta, expect in [(0.0, 8 - 4.5), (0.5, 8 - 2.25)]:
+        depths = [_depth(stacked, cfg, theta, jax.random.PRNGKey(s))
+                  for s in range(60)]
+        assert abs(np.mean(depths) - expect) < 0.7, (theta, np.mean(depths))
+
+
+def test_pld_off_is_default():
+    stacked, cfg = _counting_blocks(L=4, H=16)
+    x = jnp.zeros((1, 4, cfg.hidden_size), jnp.float32)
+    out = apply_blocks(stacked, x, cfg, rng=jax.random.PRNGKey(0),
+                       deterministic=False)    # no pld_theta
+    assert abs(float(out.mean()) - 4.0) < 1e-5
+
+
+def test_engine_pld_trains():
+    """Engine with PLD enabled: theta threads into gpt2_loss_fn and the
+    model still trains."""
+    from deepspeed_tpu.models import GPT2_CONFIGS, gpt2_init, gpt2_loss_fn
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.topology import build_mesh
+    cfg = GPT2_CONFIGS["gpt2-tiny"]
+    mesh = build_mesh(devices=jax.devices()[:1])
+    eng = DeepSpeedEngine(
+        model=gpt2_loss_fn(cfg),
+        model_params=gpt2_init(jax.random.PRNGKey(0), cfg),
+        config={"train_batch_size": 4, "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                "progressive_layer_drop": {"enabled": True, "theta": 0.5,
+                                           "gamma": 0.01},
+                "steps_per_print": 10 ** 9}, mesh=mesh)
+    assert eng.progressive_layer_drop is not None
+    rng = np.random.default_rng(0)
+    batch = rng.integers(0, cfg.vocab_size,
+                         size=(4, cfg.max_seq_length + 1)).astype(np.int32)
+    losses = [float(jax.device_get(eng.train_batch(batch)))
+              for _ in range(10)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
