@@ -53,7 +53,7 @@ from .zero.partition import zero_shardings
 from .. import constants as C
 from ..ops.optimizers import build_optimizer
 from ..parallel import comm
-from ..parallel.topology import build_mesh, DP_AXIS
+from ..parallel.topology import build_mesh, DP_AXIS, MP_AXIS
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -63,8 +63,23 @@ except Exception:  # pragma: no cover
     flax_serialization = None
 
 MODEL_FILE = "mp_rank_00_model_states.msgpack"
+MODEL_FILE_FMT = "mp_rank_{:02d}_model_states.msgpack"
 OPTIM_FILE_FMT = "zero_pp_rank_0_mp_rank_00_optim_states.msgpack"
+OPTIM_SHARD_FMT = "zero_pp_rank_{}_mp_rank_00_optim_states.msgpack"
 LATEST_FILE = "latest"
+
+
+def _spec_axis(sharding, axis_name: str):
+    """Index of the dimension a NamedSharding partitions over ``axis_name``
+    (None when unsharded on that axis)."""
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    for i, entry in enumerate(spec):
+        if entry == axis_name or (isinstance(entry, (tuple, list)) and
+                                  axis_name in entry):
+            return i
+    return None
 
 
 def _cast_floats(tree: Any, dtype) -> Any:
@@ -1149,26 +1164,30 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
                         save_latest: bool = True) -> bool:
-        """Save model+optimizer+counters under ``save_dir/tag/`` and update
-        the ``latest`` pointer. Arrays are saved *unsharded* (gathered), so a
-        load under any dp world size re-partitions automatically — the
-        elastic-checkpoint semantics of stage1.py:848-1106 come for free."""
+        """Save under ``save_dir/tag/`` with the reference's sharded layout
+        (engine.py:1472-1572, §3.5):
+
+        - ``mp_rank_XX_model_states.msgpack`` — model params, one file per
+          TP rank when mp > 1 (each holds only that rank's slice).
+        - ``zero_pp_rank_D_mp_rank_00_optim_states.msgpack`` — one file per
+          dp rank with that rank's ZeRO shard of the optimizer state; no
+          host ever materializes the full unsharded moments.
+        - ``latest`` pointer + ``engine_meta.json`` (counters + shard map).
+
+        Load re-assembles full arrays from the shards and re-partitions for
+        the CURRENT mesh, so dp-resize-on-load (stage1.py:848-1106 elastic
+        checkpoints) works across any dp sizes.
+        """
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
         path = os.path.join(save_dir, str(tag))
         os.makedirs(path, exist_ok=True)
 
-        host_state = jax.device_get(self.state)
-        # Host counter may lag the device value between log boundaries.
+        # Host counter may lag the device value between log boundaries —
+        # refresh BEFORE meta is built so the sidecar records the truth.
         if self._offload is None:
-            self.skipped_steps = int(host_state.skipped_steps)
-        # Offload: the fp32 masters on the host ARE the canonical weights.
-        model_blob = {
-            "module": jax.tree_util.tree_map(np.asarray, host_state.params)
-            if self._offload is None else
-            jax.tree_util.tree_map(np.asarray, self._offload.master_tree()),
-        }
+            self.skipped_steps = int(jax.device_get(self.state.skipped_steps))
         # Non-array metadata goes in a JSON sidecar: msgpack restore is
         # target-structured and would drop arbitrary client_state shapes.
         meta = {
@@ -1183,23 +1202,20 @@ class DeepSpeedEngine:
             meta["lr_scheduler"] = self.lr_scheduler.state_dict()
 
         if self._offload is not None:
-            optim_blob = {"offload": self._offload.state_dict()}
+            # Host masters ARE canonical; host-resident state saves whole.
+            model_blob = {"module": jax.tree_util.tree_map(
+                np.asarray, self._offload.master_tree())}
+            if jax.process_index() == 0:
+                with open(os.path.join(path, MODEL_FILE), "wb") as f:
+                    f.write(flax_serialization.to_bytes(model_blob))
+                with open(os.path.join(path, OPTIM_FILE_FMT), "wb") as f:
+                    f.write(flax_serialization.to_bytes(
+                        {"offload": self._offload.state_dict()}))
         else:
-            optim_blob = {
-                "opt_state": jax.tree_util.tree_map(np.asarray,
-                                                    host_state.opt_state),
-                "step": np.asarray(host_state.step),
-                "loss_scale": np.asarray(host_state.loss_scale),
-                "growth_count": np.asarray(host_state.growth_count),
-                "hysteresis": np.asarray(host_state.hysteresis),
-                "skipped": np.asarray(host_state.skipped_steps),
-            }
+            self._save_model_states(path, meta)
+            self._save_optim_shards(path, meta)
 
         if jax.process_index() == 0:
-            with open(os.path.join(path, MODEL_FILE), "wb") as f:
-                f.write(flax_serialization.to_bytes(model_blob))
-            with open(os.path.join(path, OPTIM_FILE_FMT), "wb") as f:
-                f.write(flax_serialization.to_bytes(optim_blob))
             with open(os.path.join(path, "engine_meta.json"), "w") as f:
                 json.dump(meta, f)
             if save_latest:
@@ -1207,6 +1223,80 @@ class DeepSpeedEngine:
                     f.write(str(tag))
         log_dist(f"saved checkpoint {path}", ranks=[0])
         return True
+
+    @staticmethod
+    def _effective_axes(leaves, sh_leaves, axis_name: str, n: int):
+        """Per-leaf shard axis, demoted to None (replicated in the files)
+        when the leaf can't be split evenly."""
+        axes = []
+        for leaf, sh in zip(leaves, sh_leaves):
+            ax = _spec_axis(sh, axis_name)
+            if ax is not None and (not hasattr(leaf, "ndim") or leaf.ndim == 0
+                                   or leaf.shape[ax] % n != 0):
+                ax = None
+            axes.append(ax)
+        return axes
+
+    @staticmethod
+    def _write_shards(path: str, fmt: str, n: int, leaves, axes,
+                      extras_shard0: Optional[Dict[str, Any]] = None) -> None:
+        """Write one msgpack file per rank with that rank's slices;
+        replicated leaves and extras ride shard 0 only."""
+        for r in range(n):
+            blob: Dict[str, Any] = {}
+            for i, (leaf, ax) in enumerate(zip(leaves, axes)):
+                if ax is None:
+                    if r == 0:
+                        blob[str(i)] = np.asarray(jax.device_get(leaf))
+                    continue
+                c = leaf.shape[ax] // n
+                sl = [slice(None)] * leaf.ndim
+                sl[ax] = slice(r * c, (r + 1) * c)
+                blob[str(i)] = np.asarray(jax.device_get(leaf[tuple(sl)]))
+            if r == 0 and extras_shard0:
+                blob.update(extras_shard0)
+            if jax.process_index() == 0:
+                with open(os.path.join(path, fmt.format(r)), "wb") as f:
+                    f.write(flax_serialization.msgpack_serialize(blob))
+
+    def _save_model_states(self, path: str, meta: Dict[str, Any]) -> None:
+        """Model params: single mp_rank_00 file, or per-TP-rank slice files
+        when mp > 1 (reference mp_rank_XX naming, engine.py:1275-1280)."""
+        mp = int(self.mesh.shape.get(MP_AXIS, 1))
+        param_leaves = jax.tree_util.tree_leaves(self.state.params)
+        sh_leaves = jax.tree_util.tree_leaves(self._state_shardings.params)
+        axes = self._effective_axes(param_leaves, sh_leaves, MP_AXIS, mp)
+        if mp > 1 and any(ax is not None for ax in axes):
+            meta["mp_shards"] = mp
+            meta["param_shard_axes"] = axes
+            self._write_shards(path, MODEL_FILE_FMT, mp, param_leaves, axes)
+            return
+        host_params = jax.tree_util.tree_map(
+            lambda x: np.asarray(jax.device_get(x)), self.state.params)
+        if jax.process_index() == 0:
+            with open(os.path.join(path, MODEL_FILE), "wb") as f:
+                f.write(flax_serialization.to_bytes({"module": host_params}))
+
+    def _save_optim_shards(self, path: str, meta: Dict[str, Any]) -> None:
+        """One optim file per dp rank holding that rank's ZeRO shard
+        (zero_pp_rank_D naming, engine.py:1262-1268). Scalars and
+        replicated leaves ride shard 0."""
+        dp = self.dp_size
+        opt_leaves = jax.tree_util.tree_leaves(self.state.opt_state)
+        sh_leaves = jax.tree_util.tree_leaves(self._state_shardings.opt_state)
+        axes = self._effective_axes(opt_leaves, sh_leaves, DP_AXIS, dp)
+        meta["optim_shards"] = dp
+        meta["optim_shard_axes"] = axes
+        scalars = {"__scalars__": {
+            "step": np.asarray(jax.device_get(self.state.step)),
+            "loss_scale": np.asarray(jax.device_get(self.state.loss_scale)),
+            "growth_count": np.asarray(
+                jax.device_get(self.state.growth_count)),
+            "hysteresis": np.asarray(jax.device_get(self.state.hysteresis)),
+            "skipped": np.asarray(jax.device_get(self.state.skipped_steps)),
+        }}
+        self._write_shards(path, OPTIM_SHARD_FMT, dp, opt_leaves, axes,
+                           extras_shard0=scalars)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_strict: bool = True,
@@ -1220,23 +1310,35 @@ class DeepSpeedEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
-        model_file = os.path.join(path, MODEL_FILE)
-        if not os.path.isfile(model_file):
-            logger.warning(f"checkpoint {model_file} not found")
-            return None, {}
-
-        host_state = jax.device_get(self.state)
-        params_target = host_state.params if self._offload is None \
-            else jax.device_get(self._offload.master_tree())
-        with open(model_file, "rb") as f:
-            model_blob = flax_serialization.from_bytes(
-                {"module": params_target}, f.read())
-        new_params = model_blob["module"]
         meta_file = os.path.join(path, "engine_meta.json")
         meta = {}
         if os.path.isfile(meta_file):
             with open(meta_file) as f:
                 meta = json.load(f)
+
+        host_state = jax.device_get(self.state)
+        params_target = host_state.params if self._offload is None \
+            else jax.device_get(self._offload.master_tree())
+        if meta.get("pipeline_layer_files"):
+            new_params = self._load_pipeline_layer_states(
+                path, meta, params_target)
+            if new_params is None:
+                return None, {}
+        elif meta.get("mp_shards"):
+            new_params = self._assemble_shards(
+                path, MODEL_FILE_FMT, int(meta["mp_shards"]),
+                meta["param_shard_axes"], params_target)
+            if new_params is None:
+                return None, {}
+        else:
+            model_file = os.path.join(path, MODEL_FILE)
+            if not os.path.isfile(model_file):
+                logger.warning(f"checkpoint {model_file} not found")
+                return None, {}
+            with open(model_file, "rb") as f:
+                model_blob = flax_serialization.from_bytes(
+                    {"module": params_target}, f.read())
+            new_params = model_blob["module"]
         self.global_steps = int(meta.get("global_steps", 0))
         self.global_samples = int(meta.get("global_samples", 0))
         self.skipped_steps = int(meta.get("skipped_steps", 0))
@@ -1267,18 +1369,44 @@ class DeepSpeedEngine:
             log_dist(f"loaded offload checkpoint {path} at "
                      f"global_step={self.global_steps}", ranks=[0])
             return path, meta.get("client_state", {})
-        if load_optimizer_states:
+        if load_optimizer_states and meta.get("optim_shards"):
+            # Sharded layout: re-assemble the full state from every saved
+            # dp rank's file; _place_state re-partitions for the CURRENT
+            # mesh — elastic dp-resize (stage1.py:848-1106).
+            saved_dp = int(meta["optim_shards"])
+            assembled = self._assemble_shards(
+                path, OPTIM_SHARD_FMT, saved_dp, meta["optim_shard_axes"],
+                host_state.opt_state)
+            if assembled is not None:
+                scalars = self._read_optim_scalars(path)
+                updates.update(
+                    opt_state=assembled,
+                    step=jnp.asarray(scalars["step"]),
+                    loss_scale=jnp.asarray(scalars["loss_scale"]),
+                    growth_count=jnp.asarray(scalars["growth_count"]),
+                    hysteresis=jnp.asarray(scalars["hysteresis"]),
+                    skipped_steps=jnp.asarray(scalars["skipped"]))
+        elif load_optimizer_states:
             optim_file = os.path.join(path, OPTIM_FILE_FMT)
             if os.path.isfile(optim_file):
                 with open(optim_file, "rb") as f:
-                    optim_blob = flax_serialization.from_bytes(
-                        {"opt_state": host_state.opt_state,
-                         "step": np.asarray(host_state.step),
-                         "loss_scale": np.asarray(host_state.loss_scale),
-                         "growth_count": np.asarray(host_state.growth_count),
-                         "hysteresis": np.asarray(host_state.hysteresis),
-                         "skipped": np.asarray(host_state.skipped_steps)},
-                        f.read())
+                    raw = f.read()
+                # New sharded files reuse the legacy rank-0 name; without
+                # engine_meta.json we can't know the shard axes — fail with
+                # a real message, not a flax structure explosion.
+                probe = flax_serialization.msgpack_restore(raw)
+                if isinstance(probe, dict) and "__scalars__" in probe:
+                    raise ValueError(
+                        f"{optim_file} is a SHARDED optimizer checkpoint "
+                        "but engine_meta.json is missing/unreadable — "
+                        "restore the sidecar to load it")
+                optim_blob = flax_serialization.from_bytes(
+                    {"opt_state": host_state.opt_state,
+                     "step": np.asarray(host_state.step),
+                     "loss_scale": np.asarray(host_state.loss_scale),
+                     "growth_count": np.asarray(host_state.growth_count),
+                     "hysteresis": np.asarray(host_state.hysteresis),
+                     "skipped": np.asarray(host_state.skipped_steps)}, raw)
                 updates.update(
                     opt_state=optim_blob["opt_state"],
                     step=jnp.asarray(optim_blob["step"]),
@@ -1295,6 +1423,48 @@ class DeepSpeedEngine:
         log_dist(f"loaded checkpoint {path} at global_step={self.global_steps}",
                  ranks=[0])
         return path, meta.get("client_state", {})
+
+    def _assemble_shards(self, path: str, fmt: str, n: int, axes,
+                         target_tree):
+        """Read ``n`` shard files and concatenate each leaf along its
+        recorded axis (replicated leaves come from shard 0). Returns the
+        full tree with ``target_tree``'s structure, or None if files are
+        missing."""
+        blobs = []
+        for r in range(n):
+            fp = os.path.join(path, fmt.format(r))
+            if not os.path.isfile(fp):
+                logger.warning(f"checkpoint shard {fp} not found")
+                return None
+            with open(fp, "rb") as f:
+                blobs.append(flax_serialization.msgpack_restore(f.read()))
+        leaves, treedef = jax.tree_util.tree_flatten(target_tree)
+        out = []
+        for i, (leaf, ax) in enumerate(zip(leaves, axes)):
+            if ax is None:
+                val = blobs[0][str(i)]
+            else:
+                val = np.concatenate([b[str(i)] for b in blobs], axis=int(ax))
+            if hasattr(leaf, "shape") and np.shape(val) != np.shape(leaf):
+                # Elastic-incompatible leaf (e.g. onebit worker_error's
+                # per-rank [dp] axis under a different dp): keep the current
+                # (fresh) value rather than loading a wrong-shaped one.
+                logger.warning(
+                    f"checkpoint leaf {i}: saved shape {np.shape(val)} != "
+                    f"current {np.shape(leaf)}; keeping current value")
+                val = leaf
+            out.append(val)
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def _load_pipeline_layer_states(self, path, meta, params_target):
+        raise NotImplementedError(
+            "checkpoint has pipeline per-layer files; load it through a "
+            "PipelineEngine")
+
+    def _read_optim_scalars(self, path: str) -> Dict[str, Any]:
+        with open(os.path.join(path, OPTIM_SHARD_FMT.format(0)), "rb") as f:
+            blob = flax_serialization.msgpack_restore(f.read())
+        return blob["__scalars__"]
 
     def _checkpoint_tag_validation(self, tag: str) -> None:
         """Cross-host tag consistency vote (engine.py:1455-1470): under SPMD

@@ -171,3 +171,44 @@ class PipelineEngine(DeepSpeedEngine):
                 return loss_head(x, labels)
             return x
         return loss_fn
+
+    # ------------------------------------------------------------------ #
+    # Per-layer checkpoint files (reference pipe/module.py:510-567:
+    # 'layer_NN-model_states.pt' written per layer, tied params once)
+    # ------------------------------------------------------------------ #
+    LAYER_FILE_FMT = "layer_{:02d}-model_states.msgpack"
+
+    def _save_model_states(self, path, meta):
+        import os
+        import numpy as np
+        from flax import serialization
+        if self.pipeline_module is None:
+            return super()._save_model_states(path, meta)
+        host = jax.device_get(self.state.params)
+        layer_files = {}
+        for i in range(len(self.pipeline_module.layers)):
+            key = self.pipeline_module.param_key(i)
+            if key in layer_files:
+                continue        # tied params: first owner writes the file
+            fname = self.LAYER_FILE_FMT.format(i)
+            layer_files[key] = fname
+            blob = jax.tree_util.tree_map(np.asarray, host.get(key, {}))
+            if jax.process_index() == 0:
+                with open(os.path.join(path, fname), "wb") as f:
+                    f.write(serialization.to_bytes(blob))
+        meta["pipeline_layer_files"] = layer_files
+
+    def _load_pipeline_layer_states(self, path, meta, params_target):
+        import os
+        from flax import serialization
+        layer_files = meta["pipeline_layer_files"]
+        out = dict(params_target)
+        for key, fname in layer_files.items():
+            fp = os.path.join(path, fname)
+            if not os.path.isfile(fp):
+                logger.warning(f"pipeline layer checkpoint {fp} missing")
+                return None
+            with open(fp, "rb") as f:
+                out[key] = serialization.from_bytes(params_target[key],
+                                                    f.read())
+        return out
