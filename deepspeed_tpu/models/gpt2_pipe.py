@@ -34,10 +34,11 @@ class PipeSpec:
     shardings: Dict[str, Any]
     num_layers: int
 
-    def loss_fn(self, num_stages: int, num_micro: int, mesh):
+    def loss_fn(self, num_stages: int, num_micro: int, mesh,
+                remat: bool = True):
         from ..runtime.pipe.spmd import spmd_pipeline_loss
         return spmd_pipeline_loss(self.embed_fn, self.stage_fn, self.head_fn,
-                                  num_stages, num_micro, mesh)
+                                  num_stages, num_micro, mesh, remat=remat)
 
 
 def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
@@ -65,12 +66,13 @@ def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
                             deterministic=cfg.hidden_dropout == 0.0)
 
     def head_fn(shared, x, targets, rng):
+        from ..ops.cross_entropy import chunked_softmax_xent
         x = layer_norm(x, shared["ln_f_scale"], shared["ln_f_bias"],
                        cfg.layer_norm_eps)
-        logits = (x @ shared["wte"].astype(cfg.dtype).T).astype(jnp.float32)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(nll)
+        B, S, H = x.shape
+        return chunked_softmax_xent(x.reshape(B * S, H),
+                                    shared["wte"].astype(cfg.dtype),
+                                    targets.reshape(-1))
 
     return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
                     params=params, shardings=shardings,

@@ -63,6 +63,9 @@ class TransformerConfig:
     # weight loads with compute across layer boundaries (better step time,
     # slower compile) — the usual TPU tradeoff.
     scan_layers: bool = True
+    # True = erf-form GELU (HF BERT "gelu"); False = tanh approximation
+    # (GPT-2 gelu_new, and what the reference's gelu_kernels.cu computes).
+    gelu_exact: bool = False
 
     @property
     def ffn_size(self) -> int:
@@ -231,7 +234,8 @@ def transformer_block(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
     # --- FFN sublayer ---
     h = layer_norm(x, params["ln2_scale"], params["ln2_bias"],
                    cfg.layer_norm_eps) if cfg.pre_layer_norm else x
-    h = gelu(dense(h, params["fc_kernel"], params["fc_bias"]))
+    h = dense(h, params["fc_kernel"], params["fc_bias"])
+    h = jax.nn.gelu(h, approximate=not cfg.gelu_exact)
     h = dense(h, params["fc_out_kernel"], params["fc_out_bias"])
     h = dropout(h, cfg.hidden_dropout, r3, deterministic)
     x = x + h

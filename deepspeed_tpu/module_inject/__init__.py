@@ -1,0 +1,20 @@
+"""Module injection — swap HF transformer layers for the fast in-repo blocks.
+
+Parity with reference ``module_inject/inject.py:6-83`` (HF BertLayer weights
+copied into DeepSpeedTransformerLayer with qkv concatenation at :27-41) and
+``replace_module.py:6-192`` (policy-driven swap + bidirectional copy).
+
+TPU-native form: instead of mutating an nn.Module tree, the injector maps a
+HuggingFace *Flax* parameter tree into the stacked block-parameter layout of
+``models.transformer`` (one [L, ...] tensor per weight, consumed by
+``apply_blocks``'s scan and the Pallas flash-attention path), and back. The
+"policy" is a pure description of where each weight lives in the HF tree.
+"""
+from .replace import (bert_config_from_hf, extract_bert_encoder,
+                      gpt2_config_from_hf, extract_gpt2_blocks,
+                      restore_bert_encoder, restore_gpt2_blocks)
+
+__all__ = [
+    "bert_config_from_hf", "extract_bert_encoder", "restore_bert_encoder",
+    "gpt2_config_from_hf", "extract_gpt2_blocks", "restore_gpt2_blocks",
+]

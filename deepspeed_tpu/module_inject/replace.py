@@ -1,0 +1,194 @@
+"""HF Flax <-> deepspeed_tpu stacked-block weight mapping.
+
+Reference mapping being reproduced (module_inject/inject.py:27-41): the
+separate q/k/v projection weights concatenate into one fused qkv tensor;
+attention-output/LayerNorm/FFN tensors map 1:1. Both directions are exact
+(copy, no recompute), so inject -> restore is the identity.
+
+Layout notes:
+- HF Flax BERT uses flax Dense kernels of shape [in, out] — same as ours.
+- HF Flax GPT-2 uses Conv1D kernels stored TRANSPOSED ([out, in]); qkv is
+  already fused in ``c_attn`` with q,k,v order matching our split.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.transformer import TransformerConfig
+
+
+def _stack(layers, path):
+    out = [l for l in layers]
+    for key in path:
+        out = [l[key] for l in out]
+    return jnp.stack([jnp.asarray(x) for x in out])
+
+
+# --------------------------------------------------------------------- #
+# BERT (post-LN encoder)
+# --------------------------------------------------------------------- #
+def bert_config_from_hf(hf_config) -> TransformerConfig:
+    act = getattr(hf_config, "hidden_act", "gelu")
+    return TransformerConfig(
+        hidden_size=hf_config.hidden_size,
+        num_heads=hf_config.num_attention_heads,
+        num_layers=hf_config.num_hidden_layers,
+        intermediate_size=hf_config.intermediate_size,
+        max_seq_length=hf_config.max_position_embeddings,
+        vocab_size=hf_config.vocab_size,
+        pre_layer_norm=False,              # original BERT is post-LN
+        hidden_dropout=hf_config.hidden_dropout_prob,
+        attn_dropout=hf_config.attention_probs_dropout_prob,
+        layer_norm_eps=hf_config.layer_norm_eps,
+        causal=False,
+        gelu_exact=act == "gelu",          # HF "gelu" is the erf form
+    )
+
+
+def extract_bert_encoder(hf_params: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+    """FlaxBertModel params -> stacked block params (qkv concat)."""
+    layer_dict = hf_params["encoder"]["layer"]
+    layers = [layer_dict[str(i)] for i in range(len(layer_dict))]
+
+    def cat_qkv(which):
+        parts = []
+        for l in layers:
+            s = l["attention"]["self"]
+            parts.append(jnp.concatenate(
+                [jnp.asarray(s[n][which]) for n in ("query", "key", "value")],
+                axis=-1))
+        return jnp.stack(parts)
+
+    return {
+        "ln1_scale": _stack(layers, ("attention", "output", "LayerNorm",
+                                     "scale")),
+        "ln1_bias": _stack(layers, ("attention", "output", "LayerNorm",
+                                    "bias")),
+        "qkv_kernel": cat_qkv("kernel"),
+        "qkv_bias": cat_qkv("bias"),
+        "proj_kernel": _stack(layers, ("attention", "output", "dense",
+                                       "kernel")),
+        "proj_bias": _stack(layers, ("attention", "output", "dense", "bias")),
+        "ln2_scale": _stack(layers, ("output", "LayerNorm", "scale")),
+        "ln2_bias": _stack(layers, ("output", "LayerNorm", "bias")),
+        "fc_kernel": _stack(layers, ("intermediate", "dense", "kernel")),
+        "fc_bias": _stack(layers, ("intermediate", "dense", "bias")),
+        "fc_out_kernel": _stack(layers, ("output", "dense", "kernel")),
+        "fc_out_bias": _stack(layers, ("output", "dense", "bias")),
+    }
+
+
+def restore_bert_encoder(stacked: Dict[str, jnp.ndarray],
+                         hf_params: Dict[str, Any]) -> Dict[str, Any]:
+    """Stacked block params -> a NEW HF param tree (inject.py's reverse
+    copy). ``hf_params`` supplies the non-encoder subtrees unchanged."""
+    out = _clone(hf_params)
+    L = stacked["ln1_scale"].shape[0]
+    H = stacked["ln1_scale"].shape[1]
+    for i in range(L):
+        l = out["encoder"]["layer"][str(i)]
+        qkv_k = np.asarray(stacked["qkv_kernel"][i])
+        qkv_b = np.asarray(stacked["qkv_bias"][i])
+        s = l["attention"]["self"]
+        for j, n in enumerate(("query", "key", "value")):
+            s[n]["kernel"] = qkv_k[:, j * H:(j + 1) * H]
+            s[n]["bias"] = qkv_b[j * H:(j + 1) * H]
+        l["attention"]["output"]["dense"]["kernel"] = \
+            np.asarray(stacked["proj_kernel"][i])
+        l["attention"]["output"]["dense"]["bias"] = \
+            np.asarray(stacked["proj_bias"][i])
+        l["attention"]["output"]["LayerNorm"]["scale"] = \
+            np.asarray(stacked["ln1_scale"][i])
+        l["attention"]["output"]["LayerNorm"]["bias"] = \
+            np.asarray(stacked["ln1_bias"][i])
+        l["intermediate"]["dense"]["kernel"] = \
+            np.asarray(stacked["fc_kernel"][i])
+        l["intermediate"]["dense"]["bias"] = np.asarray(stacked["fc_bias"][i])
+        l["output"]["dense"]["kernel"] = \
+            np.asarray(stacked["fc_out_kernel"][i])
+        l["output"]["dense"]["bias"] = np.asarray(stacked["fc_out_bias"][i])
+        l["output"]["LayerNorm"]["scale"] = np.asarray(stacked["ln2_scale"][i])
+        l["output"]["LayerNorm"]["bias"] = np.asarray(stacked["ln2_bias"][i])
+    return out
+
+
+# --------------------------------------------------------------------- #
+# GPT-2 (pre-LN decoder; Conv1D = transposed kernels, qkv already fused)
+# --------------------------------------------------------------------- #
+def gpt2_config_from_hf(hf_config) -> TransformerConfig:
+    return TransformerConfig(
+        hidden_size=hf_config.n_embd,
+        num_heads=hf_config.n_head,
+        num_layers=hf_config.n_layer,
+        intermediate_size=getattr(hf_config, "n_inner", None) or
+        4 * hf_config.n_embd,
+        max_seq_length=hf_config.n_positions,
+        vocab_size=hf_config.vocab_size,
+        pre_layer_norm=True,
+        hidden_dropout=hf_config.resid_pdrop,
+        attn_dropout=hf_config.attn_pdrop,
+        layer_norm_eps=hf_config.layer_norm_epsilon,
+        causal=True,
+        gelu_exact=False,                  # GPT-2 uses gelu_new (tanh)
+    )
+
+
+def extract_gpt2_blocks(hf_params: Dict[str, Any]) -> Dict[str, jnp.ndarray]:
+    h = hf_params["h"]
+    layers = [h[str(i)] for i in range(len(h))]
+
+    def stackT(path):
+        return jnp.stack([jnp.asarray(_get(l, path)).T for l in layers])
+
+    return {
+        "ln1_scale": _stack(layers, ("ln_1", "scale")),
+        "ln1_bias": _stack(layers, ("ln_1", "bias")),
+        "qkv_kernel": stackT(("attn", "c_attn", "kernel")),
+        "qkv_bias": _stack(layers, ("attn", "c_attn", "bias")),
+        "proj_kernel": stackT(("attn", "c_proj", "kernel")),
+        "proj_bias": _stack(layers, ("attn", "c_proj", "bias")),
+        "ln2_scale": _stack(layers, ("ln_2", "scale")),
+        "ln2_bias": _stack(layers, ("ln_2", "bias")),
+        "fc_kernel": stackT(("mlp", "c_fc", "kernel")),
+        "fc_bias": _stack(layers, ("mlp", "c_fc", "bias")),
+        "fc_out_kernel": stackT(("mlp", "c_proj", "kernel")),
+        "fc_out_bias": _stack(layers, ("mlp", "c_proj", "bias")),
+    }
+
+
+def restore_gpt2_blocks(stacked: Dict[str, jnp.ndarray],
+                        hf_params: Dict[str, Any]) -> Dict[str, Any]:
+    out = _clone(hf_params)
+    L = stacked["ln1_scale"].shape[0]
+    for i in range(L):
+        l = out["h"][str(i)]
+        l["ln_1"]["scale"] = np.asarray(stacked["ln1_scale"][i])
+        l["ln_1"]["bias"] = np.asarray(stacked["ln1_bias"][i])
+        l["attn"]["c_attn"]["kernel"] = np.asarray(stacked["qkv_kernel"][i]).T
+        l["attn"]["c_attn"]["bias"] = np.asarray(stacked["qkv_bias"][i])
+        l["attn"]["c_proj"]["kernel"] = np.asarray(stacked["proj_kernel"][i]).T
+        l["attn"]["c_proj"]["bias"] = np.asarray(stacked["proj_bias"][i])
+        l["ln_2"]["scale"] = np.asarray(stacked["ln2_scale"][i])
+        l["ln_2"]["bias"] = np.asarray(stacked["ln2_bias"][i])
+        l["mlp"]["c_fc"]["kernel"] = np.asarray(stacked["fc_kernel"][i]).T
+        l["mlp"]["c_fc"]["bias"] = np.asarray(stacked["fc_bias"][i])
+        l["mlp"]["c_proj"]["kernel"] = np.asarray(stacked["fc_out_kernel"][i]).T
+        l["mlp"]["c_proj"]["bias"] = np.asarray(stacked["fc_out_bias"][i])
+    return out
+
+
+# --------------------------------------------------------------------- #
+def _get(tree, path):
+    for k in path:
+        tree = tree[k]
+    return tree
+
+
+def _clone(tree):
+    if isinstance(tree, dict):
+        return {k: _clone(v) for k, v in tree.items()}
+    return np.asarray(tree)

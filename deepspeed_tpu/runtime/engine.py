@@ -1336,8 +1336,17 @@ class DeepSpeedEngine:
                 logger.warning(f"checkpoint {model_file} not found")
                 return None, {}
             with open(model_file, "rb") as f:
-                model_blob = flax_serialization.from_bytes(
-                    {"module": params_target}, f.read())
+                raw_model = f.read()
+            probe = flax_serialization.msgpack_restore(raw_model)
+            if not (isinstance(probe, dict) and "module" in probe):
+                # mp-sharded shard 0 reuses the legacy filename; without the
+                # sidecar we can't know the shard axes.
+                raise ValueError(
+                    f"{model_file} is a SHARDED (mp_rank) model checkpoint "
+                    "but engine_meta.json is missing/unreadable — restore "
+                    "the sidecar to load it")
+            model_blob = flax_serialization.from_state_dict(
+                {"module": params_target}, probe)
             new_params = model_blob["module"]
         self.global_steps = int(meta.get("global_steps", 0))
         self.global_samples = int(meta.get("global_samples", 0))
@@ -1400,13 +1409,13 @@ class DeepSpeedEngine:
                         f"{optim_file} is a SHARDED optimizer checkpoint "
                         "but engine_meta.json is missing/unreadable — "
                         "restore the sidecar to load it")
-                optim_blob = flax_serialization.from_bytes(
+                optim_blob = flax_serialization.from_state_dict(
                     {"opt_state": host_state.opt_state,
                      "step": np.asarray(host_state.step),
                      "loss_scale": np.asarray(host_state.loss_scale),
                      "growth_count": np.asarray(host_state.growth_count),
                      "hysteresis": np.asarray(host_state.hysteresis),
-                     "skipped": np.asarray(host_state.skipped_steps)}, raw)
+                     "skipped": np.asarray(host_state.skipped_steps)}, probe)
                 updates.update(
                     opt_state=optim_blob["opt_state"],
                     step=jnp.asarray(optim_blob["step"]),
@@ -1439,6 +1448,11 @@ class DeepSpeedEngine:
             with open(fp, "rb") as f:
                 blobs.append(flax_serialization.msgpack_restore(f.read()))
         leaves, treedef = jax.tree_util.tree_flatten(target_tree)
+        if len(leaves) != len(axes):
+            raise ValueError(
+                f"checkpoint shard layout has {len(axes)} leaves but the "
+                f"current state has {len(leaves)} — the optimizer/model "
+                "structure changed since this checkpoint was saved")
         out = []
         for i, (leaf, ax) in enumerate(zip(leaves, axes)):
             if ax is None:

@@ -62,7 +62,15 @@ class PipelineEngine(DeepSpeedEngine):
             gas = self._peek_gas(config, int(mesh.shape.get(DP_AXIS, 1)))
             m = num_micro_batches or gas
             self._num_micro = m
-            loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh)
+            # activation_checkpoint_interval (reference pipe/module.py:
+            # 292-346 checkpoints every N layers in forward): an EXPLICIT 0
+            # disables the per-tick stage remat; >=1 enables it (stage
+            # granularity — finer per-layer policy lives in the model's
+            # remat_policy). Key absent -> remat stays ON (the memory-safe
+            # default this pipeline has always had).
+            interval = self._peek_actckpt_interval(config)
+            loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh,
+                                    remat=interval is None or interval != 0)
             super().__init__(args=args, model=loss_fn, optimizer=optimizer,
                              model_params=model_params or model.params,
                              training_data=training_data,
@@ -92,6 +100,22 @@ class PipelineEngine(DeepSpeedEngine):
                 "pp>1 needs uniform stages: express the model as a PipeSpec "
                 "(models/gpt2_pipe.py) for the compiled SPMD pipeline")
         log_dist(self.pipeline_module.describe(), ranks=[0])
+
+    @staticmethod
+    def _peek_actckpt_interval(config):
+        """Read pipeline.activation_checkpoint_interval before the base
+        engine has parsed the config. Returns None when the key is absent
+        (caller keeps remat on); an explicit value (incl. 0) is honored."""
+        from ..config import DeepSpeedConfig
+        from ..config_utils import load_config_json
+        if isinstance(config, str):
+            config = load_config_json(config)
+        if isinstance(config, DeepSpeedConfig):
+            config = getattr(config, "_param_dict", None)
+        if isinstance(config, dict):
+            v = config.get("pipeline", {}).get("activation_checkpoint_interval")
+            return None if v is None else int(v)
+        return None
 
     @staticmethod
     def _peek_gas(config, dp: int = 1) -> int:

@@ -183,3 +183,80 @@ class PipelineModule:
             names = [type(l).__name__ for l in self.layers[lo:hi]]
             lines.append(f"  stage {s}: layers {lo}..{hi - 1} {names}")
         return "\n".join(lines)
+
+    # ------------------------------------------------------------------ #
+    def to_pipe_spec(self, params: Dict[str, Any], embed_fn=None,
+                     head_fn=None):
+        """Uniform-stage conversion: the documented path from a layer-list
+        PipelineModule to the compiled pp>1 SPMD pipeline.
+
+        Requirements (checked): every layer is the SAME function (one block
+        program scanned over stacked weights — the shape the SPMD pipeline
+        executes), no tied layers, and every layer's param tree has one
+        structure/shape. Models that don't fit (heterogeneous stages, tied
+        embeddings) should be expressed directly as a PipeSpec
+        (models/gpt2_pipe.py) instead.
+
+        ``params``: the engine-style {param_key: layer_params} tree.
+        Returns a PipeSpec consumable by PipelineEngine on a pp>1 mesh.
+        """
+        from ...models.gpt2_pipe import PipeSpec
+        from .spmd import pipeline_param_shardings
+        from jax.sharding import PartitionSpec as P
+        import jax.numpy as jnp
+        from jax import lax
+
+        L = len(self.layers)
+        keys = [self.param_key(i) for i in range(L)]
+        if len(set(keys)) != L:
+            raise ValueError(
+                "tied layers cannot be auto-converted to a PipeSpec; "
+                "express the model as a PipeSpec with a shared param group")
+        layer0 = self.layers[0]
+        code0 = getattr(layer0, "__code__", None)
+        for l in self.layers[1:]:
+            if l is layer0:
+                continue
+            # Same code object is NOT enough: factory-made closures share
+            # __code__ but capture different values, and stage_fn would
+            # silently run layer0's closure for every layer. Accept distinct
+            # objects only when both are closure-free plain functions.
+            same_code = code0 is not None and \
+                getattr(l, "__code__", None) is code0
+            closure_free = getattr(layer0, "__closure__", None) is None and \
+                getattr(l, "__closure__", None) is None
+            if same_code and closure_free:
+                continue
+            raise ValueError(
+                "pp>1 conversion needs uniform stages: every layer must be "
+                "the SAME function object (closures with captured state "
+                "cannot be verified equal); got differing layer callables")
+        trees = [params[k] for k in keys]
+        td0 = jax.tree_util.tree_structure(trees[0])
+        for t in trees[1:]:
+            if jax.tree_util.tree_structure(t) != td0:
+                raise ValueError("layer param trees differ in structure; "
+                                 "uniform stages required for pp>1")
+        blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+        def stage_fn(blocks_local, x, rng):
+            def body(h, p):
+                return layer0(p, h), None
+            x, _ = lax.scan(body, x, blocks_local)
+            return x
+
+        if embed_fn is None:
+            embed_fn = lambda shared, tokens, rng: tokens
+        if head_fn is None:
+            loss_head = self.loss_fn
+            if loss_head is None:
+                raise ValueError("PipelineModule has no loss_fn; pass "
+                                 "head_fn explicitly")
+            head_fn = lambda shared, x, targets, rng: loss_head(x, targets)
+
+        shardings = pipeline_param_shardings(
+            shared_specs={},
+            block_specs=jax.tree_util.tree_map(lambda _: P(), blocks))
+        return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
+                        params={"shared": {}, "blocks": blocks},
+                        shardings=shardings, num_layers=L)

@@ -30,10 +30,10 @@ Division of labor (the load-bearing design decision):
   one differentiated program — no explicit cross-stage psum of parameter
   cotangents is ever constructed.
 
-The pipeline's inputs cross into the manual region replicated-over-pipe in
-float32: the transpose of that boundary is a psum over ``pipe`` of the input
-cotangent, and fp32 keeps that all-reduce off the XLA bf16 promotion path.
-Activations inside the scan run in the model's compute dtype (bf16).
+The pipeline's input bank crosses into the manual region in the compute
+dtype (bf16); each tick's slice is routed through fp32 around the pvary so
+its transpose-psum over ``pipe`` stays off the XLA bf16 promotion path.
+Cross-stage ppermute transfers are bf16 throughout.
 
 Composition: the ``pipe`` axis is *manual* (shard_map ``axis_names``); data/
 model/seq axes stay *auto*, so GSPMD still partitions the batch over dp and
@@ -72,28 +72,32 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
     M, Pstages = num_micro_batches, num_stages
     T = M + Pstages - 1
 
-    def per_stage(blocks_local, micro_x32, rng, cdtype):
+    def per_stage(blocks_local, micro_x, rng, cdtype):
         """One pipeline stage's full schedule: T ticks of compute+rotate.
 
-        ``micro_x32``: [M, mb, ...] embedded micro-batches, fp32,
+        ``micro_x``: [M, mb, ...] embedded micro-batches in the COMPUTE
+        dtype (the input bank is bf16 — half the GPipe bank memory),
         replicated over pipe. Returns [1, M, mb, ...] — this stage's
         collected outputs; only stage P-1's slice is meaningful.
         """
         r = lax.axis_index(PP_AXIS)
         stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
-        buf0 = lax.pcast(jnp.zeros(micro_x32.shape[1:], cdtype), PP_AXIS, to='varying')
-        out0 = lax.pcast(jnp.zeros(micro_x32.shape, cdtype), PP_AXIS, to='varying')
+        buf0 = lax.pcast(jnp.zeros(micro_x.shape[1:], cdtype), PP_AXIS, to='varying')
+        out0 = lax.pcast(jnp.zeros(micro_x.shape, cdtype), PP_AXIS, to='varying')
 
         def tick(carry, t):
             buf, out = carry
             x0 = lax.dynamic_index_in_dim(
-                micro_x32, jnp.clip(t, 0, M - 1), 0, keepdims=False)
-            # pvary BEFORE the compute-dtype cast: the transpose of pvary is
-            # a psum over pipe, and keeping it in fp32 keeps that all-reduce
-            # off XLA's bf16 AllReducePromotion path (which CHECK-fails on
-            # sdy-annotated reduction computations in this XLA build).
-            x0 = lax.pcast(x0, PP_AXIS, to='varying').astype(cdtype)
+                micro_x, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+            # fp32-safe boundary on a PER-TICK slice: pvary's transpose is a
+            # psum over pipe, and routing it through fp32 keeps that
+            # all-reduce off XLA's bf16 AllReducePromotion path (which
+            # CHECK-fails on sdy-annotated reduction computations in this
+            # XLA build). Only the [mb, ...] tick slice is ever fp32 — the
+            # O(M) bank itself stays bf16.
+            x0 = lax.pcast(x0.astype(jnp.float32), PP_AXIS,
+                           to='varying').astype(cdtype)
             x_in = jnp.where(r == 0, x0, buf)
             key_t = jax.random.fold_in(rng, t)
             y = stage(blocks_local, x_in, key_t)
@@ -136,7 +140,7 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
             in_specs=(P(PP_AXIS), P(), P()),
             out_specs=P(PP_AXIS),
             axis_names={PP_AXIS})
-        stacked = mapped(params["blocks"], x.astype(jnp.float32), rng)
+        stacked = mapped(params["blocks"], x, rng)
         y_last = stacked[-1]                      # [M, mb, ...]
 
         # Loss head (post-pipeline). Tied params (e.g. wte) contribute here

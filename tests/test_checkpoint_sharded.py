@@ -57,7 +57,6 @@ def test_dp_resize_on_load(tmp_path, dp_load):
     eng = _engine(dp=4, lr=5e-2)
     for i in range(5):
         eng.train_batch(random_batch(32, seed=i))
-    ref_loss_next = None
     eng.save_checkpoint(str(tmp_path), tag="r")
     # continue the original engine one step for a reference trajectory
     ref_loss_next = float(jax.device_get(
@@ -66,8 +65,6 @@ def test_dp_resize_on_load(tmp_path, dp_load):
     eng2 = _engine(dp=dp_load, lr=5e-2, seed=1)
     p, _ = eng2.load_checkpoint(str(tmp_path), tag="r")
     assert p is not None
-    # params identical post-load
-    a = jax.device_get(eng.state.params)     # NOTE eng took one extra step
     b = jax.device_get(eng2.state.params)
     # compare against the SAVED state: reload into a third engine at dp=4
     eng3 = _engine(dp=4, lr=5e-2, seed=2)

