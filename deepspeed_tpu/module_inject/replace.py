@@ -33,6 +33,11 @@ def _stack(layers, path):
 # --------------------------------------------------------------------- #
 def bert_config_from_hf(hf_config) -> TransformerConfig:
     act = getattr(hf_config, "hidden_act", "gelu")
+    if act not in ("gelu", "gelu_new", "gelu_pytorch_tanh"):
+        raise ValueError(
+            f"module injection supports GELU activations only, got "
+            f"hidden_act='{act}' (the fused blocks compute GELU; injecting "
+            "would silently change the model)")
     return TransformerConfig(
         hidden_size=hf_config.hidden_size,
         num_heads=hf_config.num_attention_heads,

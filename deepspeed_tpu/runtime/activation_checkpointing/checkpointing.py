@@ -1,0 +1,149 @@
+"""Activation checkpointing with partitioning and CPU offload.
+
+Parity target: reference ``runtime/activation_checkpointing/
+checkpointing.py:370-417`` — CheckpointFunction saves each checkpointed
+function's inputs, optionally PARTITIONED across model-parallel ranks
+(:370-417 partition + :281-312 re-gather at backward) and optionally
+OFFLOADED to CPU memory (``cpu_checkpointing``), replaying RNG states in
+backward (:114-263).
+
+TPU-native mechanics — each reference knob maps to a first-class XLA
+facility instead of hand-managed buffers:
+
+- checkpointing itself  -> ``jax.checkpoint`` (remat): inputs are saved,
+  the body recomputes in backward. RNG "replay" is free: dropout keys are
+  explicit fn inputs, so the recompute sees identical randomness by
+  construction (no get_rng_state/set_rng_state juggling).
+- partition_activations -> the saved inputs carry a
+  ``with_sharding_constraint`` over the model-parallel mesh axis, so XLA
+  stores 1/mp of each residual per chip and re-gathers when the backward
+  recompute consumes it — the reference's partition + gather pair,
+  scheduled by the compiler.
+- cpu_checkpointing     -> ``save_and_offload_only_these_names``: the
+  tagged residuals live in host ("pinned_host") memory between forward
+  and backward; XLA inserts the D2H/H2D copies and overlaps them.
+
+The reference's module-level API shape (configure() once, then
+``checkpoint(function, *args)`` everywhere) is preserved so ported client
+code keeps its call sites.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.ad_checkpoint import checkpoint_name
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import MP_AXIS
+from ...utils.logging import logger
+
+_CKPT_NAME = "ds_actckpt_input"
+
+# module state set by configure() (reference checkpointing.py:558-604)
+_config = {
+    "partition_activations": False,
+    "cpu_checkpointing": False,
+    "mp_axis": MP_AXIS,
+    "partition_spec": None,       # override: PartitionSpec for saved inputs
+    "configured": False,
+}
+
+
+def configure(mpu=None, deepspeed_config=None,
+              partition_activations: Optional[bool] = None,
+              contiguous_checkpointing: Optional[bool] = None,
+              checkpoint_in_cpu: Optional[bool] = None,
+              synchronize: Optional[bool] = None,
+              profile: Optional[bool] = None,
+              mp_axis: Optional[str] = None,
+              partition_spec=None) -> None:
+    """Reference-shaped configure (checkpointing.py:558): reads the
+    activation_checkpointing section of a DeepSpeedConfig or explicit
+    flags. ``contiguous_checkpointing``/``synchronize``/``profile`` are
+    accepted for call-site parity; XLA's allocator already packs saved
+    residuals contiguously and there are no streams to synchronize."""
+    ac = getattr(deepspeed_config, "activation_checkpointing_config", None)
+    if ac is not None:
+        _config["partition_activations"] = bool(ac.partition_activations)
+        _config["cpu_checkpointing"] = bool(ac.cpu_checkpointing)
+    if partition_activations is not None:
+        _config["partition_activations"] = bool(partition_activations)
+    if checkpoint_in_cpu is not None:
+        _config["cpu_checkpointing"] = bool(checkpoint_in_cpu)
+    if mp_axis is not None:
+        _config["mp_axis"] = mp_axis
+    if partition_spec is not None:
+        _config["partition_spec"] = partition_spec
+    _config["configured"] = True
+
+
+def is_configured() -> bool:
+    return bool(_config["configured"])
+
+
+def _default_spec(ndim: int, mp_axis: str) -> P:
+    """Shard the sequence dim ([B, S, ...] activations): batch stays on dp,
+    so the mp partition rides dim 1; 1-D/2-D tensors shard dim 0."""
+    if ndim >= 3:
+        return P(*([None, mp_axis] + [None] * (ndim - 2)))
+    return P(*([mp_axis] + [None] * (ndim - 1)))
+
+
+def checkpoint_wrapper(fn: Callable,
+                       partition_activations: Optional[bool] = None,
+                       cpu_checkpointing: Optional[bool] = None,
+                       mp_axis: Optional[str] = None,
+                       partition_spec=None) -> Callable:
+    """Wrap ``fn(*args)`` with remat; per-call flags override configure().
+
+    Saved residuals = the float array inputs of ``fn`` (everything else
+    recomputes). With partitioning they are stored mp-sharded; with
+    cpu_checkpointing they are stored in host memory.
+    """
+    part = _config["partition_activations"] if partition_activations is None \
+        else partition_activations
+    cpu = _config["cpu_checkpointing"] if cpu_checkpointing is None \
+        else cpu_checkpointing
+    axis = mp_axis or _config["mp_axis"]
+    spec = partition_spec if partition_spec is not None \
+        else _config["partition_spec"]
+
+    if cpu:
+        policy = jax.checkpoint_policies.save_and_offload_only_these_names(
+            names_which_can_be_saved=[],
+            names_which_can_be_offloaded=[_CKPT_NAME],
+            offload_src="device", offload_dst="pinned_host")
+    else:
+        policy = jax.checkpoint_policies.save_only_these_names(_CKPT_NAME)
+
+    def tag(x):
+        if not hasattr(x, "dtype") or not jnp.issubdtype(x.dtype,
+                                                         jnp.floating):
+            return x
+        if part:
+            s = spec if spec is not None else _default_spec(x.ndim, axis)
+            x = lax.with_sharding_constraint(x, s)
+        return checkpoint_name(x, _CKPT_NAME)
+
+    def inner(*args):
+        return fn(*jax.tree_util.tree_map(tag, args))
+
+    return jax.checkpoint(inner, policy=policy)
+
+
+def checkpoint(function: Callable, *args) -> Any:
+    """Reference call-site parity (checkpointing.py CheckpointFunction
+    usage: ``checkpoint(fn, *inputs)``)."""
+    if not is_configured():
+        logger.warning("activation checkpointing used before configure(); "
+                       "using defaults")
+    return checkpoint_wrapper(function)(*args)
+
+
+def reset() -> None:
+    """Test hook: restore defaults."""
+    _config.update(partition_activations=False, cpu_checkpointing=False,
+                   mp_axis=MP_AXIS, partition_spec=None, configured=False)

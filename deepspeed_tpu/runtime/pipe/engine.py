@@ -70,7 +70,7 @@ class PipelineEngine(DeepSpeedEngine):
             # default this pipeline has always had).
             interval = self._peek_actckpt_interval(config)
             loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh,
-                                    remat=interval is None or interval != 0)
+                                    remat=interval != 0)
             super().__init__(args=args, model=loss_fn, optimizer=optimizer,
                              model_params=model_params or model.params,
                              training_data=training_data,
@@ -102,39 +102,37 @@ class PipelineEngine(DeepSpeedEngine):
         log_dist(self.pipeline_module.describe(), ranks=[0])
 
     @staticmethod
-    def _peek_actckpt_interval(config):
-        """Read pipeline.activation_checkpoint_interval before the base
-        engine has parsed the config. Returns None when the key is absent
-        (caller keeps remat on); an explicit value (incl. 0) is honored."""
+    def _peek_param_dict(config):
+        """Normalize any accepted config form to its raw param dict, for
+        reads that happen before the base engine parses the config."""
         from ..config import DeepSpeedConfig
         from ..config_utils import load_config_json
         if isinstance(config, str):
-            config = load_config_json(config)
+            return load_config_json(config)
         if isinstance(config, DeepSpeedConfig):
-            config = getattr(config, "_param_dict", None)
-        if isinstance(config, dict):
-            v = config.get("pipeline", {}).get("activation_checkpoint_interval")
-            return None if v is None else int(v)
-        return None
+            return getattr(config, "_param_dict", None) or {}
+        return config if isinstance(config, dict) else {}
 
-    @staticmethod
-    def _peek_gas(config, dp: int = 1) -> int:
-        """Read gradient_accumulation_steps before the base engine parses
-        the full config (the micro-batch count of the pipeline)."""
-        from ..config import DeepSpeedConfig
-        from ..config_utils import load_config_json
-        if isinstance(config, str):
-            config = load_config_json(config)
-        if isinstance(config, DeepSpeedConfig):
-            return config.gradient_accumulation_steps
-        if isinstance(config, dict):
-            tb = config.get("train_batch_size")
-            mb = config.get("train_micro_batch_size_per_gpu")
-            gas = config.get("gradient_accumulation_steps")
-            if gas:
-                return int(gas)
-            if tb and mb:
-                return max(1, int(tb) // (int(mb) * dp))
+    @classmethod
+    def _peek_actckpt_interval(cls, config):
+        """pipeline.activation_checkpoint_interval. Returns None when the
+        key is absent (caller keeps remat on — the memory-safe default); an
+        explicit value (incl. 0 = remat off) is honored."""
+        v = cls._peek_param_dict(config).get("pipeline", {}).get(
+            "activation_checkpoint_interval")
+        return None if v is None else int(v)
+
+    @classmethod
+    def _peek_gas(cls, config, dp: int = 1) -> int:
+        """gradient_accumulation_steps (the micro-batch count of the
+        pipeline), solved from the batch triple if not explicit."""
+        d = cls._peek_param_dict(config)
+        gas = d.get("gradient_accumulation_steps")
+        if gas:
+            return int(gas)
+        tb, mb = d.get("train_batch_size"), d.get("train_micro_batch_size_per_gpu")
+        if tb and mb:
+            return max(1, int(tb) // (int(mb) * dp))
         return 1
 
     def _scan_microbatches(self) -> int:

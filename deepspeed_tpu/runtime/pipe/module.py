@@ -213,6 +213,16 @@ class PipelineModule:
                 "tied layers cannot be auto-converted to a PipeSpec; "
                 "express the model as a PipeSpec with a shared param group")
         layer0 = self.layers[0]
+        if hasattr(layer0, "apply") and hasattr(layer0, "init"):
+            raise ValueError(
+                "to_pipe_spec converts plain fn(params, x) layers only; "
+                "flax-module layers need an explicit PipeSpec whose "
+                "stage_fn calls module.apply")
+        for i in range(L):
+            if keys[i] not in params:
+                raise ValueError(
+                    f"params is missing '{keys[i]}' — stateless layers "
+                    "(no params) cannot be pipelined via to_pipe_spec")
         code0 = getattr(layer0, "__code__", None)
         for l in self.layers[1:]:
             if l is layer0:

@@ -1,0 +1,86 @@
+"""Activation checkpointing: remat correctness + partitioning + CPU
+offload (reference test_activation_checkpointing.py: checkpoint-vs-plain
+forward/grad parity incl. RNG reproducibility)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ck
+from deepspeed_tpu.parallel.topology import build_mesh
+
+
+@pytest.fixture(autouse=True)
+def _reset():
+    ck.reset()
+    yield
+    ck.reset()
+
+
+def _fn(w, x, key):
+    h = jnp.tanh(x @ w)
+    h = h * jax.random.bernoulli(key, 0.8, h.shape)   # dropout-like RNG use
+    return jnp.sum(h ** 2)
+
+
+def _data(seed=0, b=8, d=16):
+    k = jax.random.PRNGKey(seed)
+    k1, k2, k3 = jax.random.split(k, 3)
+    return (jax.random.normal(k1, (d, d)), jax.random.normal(k2, (b, 4, d)),
+            k3)
+
+
+def test_checkpoint_matches_plain():
+    w, x, key = _data()
+    plain = jax.grad(_fn)(w, x, key)
+    wrapped = ck.checkpoint_wrapper(_fn)
+    remat = jax.grad(wrapped)(w, x, key)
+    np.testing.assert_allclose(np.asarray(remat), np.asarray(plain),
+                               rtol=1e-6)
+
+
+def test_rng_replay_reproducible():
+    """The recompute in backward must see the same dropout mask — explicit
+    key inputs make this structural; verify grads are deterministic."""
+    w, x, key = _data(1)
+    wrapped = ck.checkpoint_wrapper(_fn)
+    g1 = jax.grad(wrapped)(w, x, key)
+    g2 = jax.grad(wrapped)(w, x, key)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(g2))
+
+
+def test_partitioned_checkpoint_under_mesh():
+    """partition_activations: saved inputs carry an mp sharding constraint;
+    grads still match the plain function on a dp x mp mesh."""
+    mesh = build_mesh(mp=2, devices=jax.devices()[:4])
+    ck.configure(partition_activations=True)
+    w, x, key = _data(2)
+    with jax.set_mesh(mesh) if hasattr(jax, "set_mesh") else mesh:
+        wrapped = ck.checkpoint_wrapper(_fn)
+        remat = jax.jit(jax.grad(wrapped))(w, x, key)
+        plain = jax.jit(jax.grad(_fn))(w, x, key)
+    np.testing.assert_allclose(np.asarray(remat), np.asarray(plain),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_cpu_offload_checkpoint():
+    """cpu_checkpointing: residuals tagged for host offload; numerics
+    unchanged."""
+    ck.configure(checkpoint_in_cpu=True)
+    w, x, key = _data(3)
+    wrapped = ck.checkpoint_wrapper(_fn)
+    try:
+        remat = jax.jit(jax.grad(wrapped))(w, x, key)
+    except Exception as e:     # backend without host-memory support
+        pytest.skip(f"host offload unsupported on this backend: {e}")
+    plain = jax.grad(_fn)(w, x, key)
+    np.testing.assert_allclose(np.asarray(remat), np.asarray(plain),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_module_level_checkpoint_api():
+    w, x, key = _data(4)
+    ck.configure()
+    out = ck.checkpoint(_fn, w, x, key)
+    np.testing.assert_allclose(float(out), float(_fn(w, x, key)), rtol=1e-6)
+    assert ck.is_configured()
