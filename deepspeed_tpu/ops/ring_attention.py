@@ -1,0 +1,152 @@
+"""Ring attention — sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context capability beyond the reference snapshot: v0.3.11 has no
+sequence/context parallelism (its ``slice_parallel`` accessors alias the
+model-parallel axis, topology.py:445-455) and handles long sequences
+algorithmically via block-sparse attention. Ring attention shards the
+SEQUENCE across chips so attention memory AND compute scale 1/sp per chip
+while remaining exact — the modern long-context story (Ring Attention /
+Context Parallelism), built here from the same primitives as the rest of
+the framework: ``shard_map`` over the ``seq`` axis, ``lax.ppermute``
+rotations over ICI, and flash-style online-softmax merging.
+
+Algorithm (per rank, holding local q,k,v [B, S/sp, nH, dH]):
+  for step in 0..sp-1:
+      partial = flash(q_local, k_chunk, v_chunk) -> (o_chunk, lse_chunk)
+      merge into (o, lse) with the online-softmax rule
+      (k_chunk, v_chunk) <- ppermute from the next rank   # ring hop
+  o is EXACT full attention of q_local against the whole sequence.
+
+Causal masking uses global positions: chunk c covers columns
+[c*S_loc, (c+1)*S_loc); a rank skips nothing (uniform SPMD program) but
+masks per-element, so correctness holds for any rotation order.
+
+Backward is jax autodiff through the scan: the ppermute transposes into
+counter-rotations of the gradient chunks — the reverse ring — and the
+per-chunk attention recomputes under ``jax.checkpoint`` (memory stays
+O(S_loc) per rank).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.topology import SP_AXIS
+
+NEG_INF = -1e30
+
+
+def _chunk_attention(q, k, v, scale: float, causal: bool,
+                     q_start, k_start):
+    """Dense attention of local q against one k/v chunk, returning
+    (acc [B,Sq,nH,dH] fp32 UNnormalized, m [B,nH,Sq] rowmax,
+    l [B,nH,Sq] rowsum) for online-softmax merging. Global positions
+    ``q_start``/``k_start`` drive the causal mask."""
+    B, Sq, nH, D = q.shape
+    Sk = k.shape[1]
+    s = jnp.einsum("bsnd,btnd->bnst", q, k).astype(jnp.float32) * scale
+    if causal:
+        rows = q_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        cols = k_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        s = jnp.where((rows >= cols)[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)                               # [B,nH,Sq]
+    # rows fully masked (causal, all cols in the future): exp(NEG_INF-m)=...
+    # guard by clamping m so exp() sees finite numbers.
+    m_safe = jnp.maximum(m, NEG_INF / 2)
+    p = jnp.exp(s - m_safe[..., None])                    # [B,nH,Sq,Sk]
+    l = jnp.sum(p, axis=-1)                               # [B,nH,Sq]
+    acc = jnp.einsum("bnst,btnd->bsnd", p.astype(v.dtype), v)
+    return acc.astype(jnp.float32), m_safe, l
+
+
+def _merge(acc1, m1, l1, acc2, m2, l2):
+    """Online-softmax merge of two partial attention states."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    acc = acc1 * a1.transpose(0, 2, 1)[..., None] + \
+        acc2 * a2.transpose(0, 2, 1)[..., None]
+    l = l1 * a1 + l2 * a2
+    return acc, m, l
+
+
+def _ring_attention_local(q, k, v, *, scale: float, causal: bool,
+                          sp: int, axis_name: str):
+    """Runs inside shard_map: q,k,v are the rank-local [B, S_loc, nH, dH]."""
+    B, S_loc, nH, D = q.shape
+    rank = lax.axis_index(axis_name)
+    q_start = rank * S_loc
+
+    perm = [(i, (i - 1) % sp) for i in range(sp)]  # pull chunks from right
+
+    def step(carry, i):
+        acc, m, l, kc, vc = carry
+        # chunk currently held = the one that started on rank (rank + i)
+        k_start = ((rank + i) % sp) * S_loc
+        acc2, m2, l2 = _chunk_attention(q, kc, vc, scale, causal,
+                                        q_start, k_start)
+        acc, m, l = _merge(acc, m, l, acc2, m2, l2)
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        return (acc, m, l, kc, vc), None
+
+    # Carries must be marked varying-over-seq like the data they merge with.
+    vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    acc0 = vary(jnp.zeros((B, S_loc, nH, D), jnp.float32))
+    m0 = vary(jnp.full((B, nH, S_loc), NEG_INF / 2, jnp.float32))
+    l0 = vary(jnp.zeros((B, nH, S_loc), jnp.float32))
+    (acc, m, l, _, _), _ = lax.scan(
+        jax.checkpoint(step), (acc0, m0, l0, k, v), jnp.arange(sp))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    out = acc / l_safe.transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   mesh: Mesh, causal: bool = False,
+                   axis_name: str = SP_AXIS) -> jnp.ndarray:
+    """Exact attention with the sequence sharded over ``axis_name``.
+
+    q,k,v: [B, S, nH, dH] GLOBAL arrays (jit/GSPMD handles placement; the
+    sequence dim is split over the seq axis inside). Returns [B, S, nH, dH].
+    Per-chip attention memory/compute is 1/sp of the full sequence.
+    """
+    sp = int(mesh.shape.get(axis_name, 1))
+    B, S, nH, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+    if sp <= 1:
+        from ..models.transformer import dense_attention
+        return dense_attention(q, k, v, mask=None, causal=causal)
+    if S % sp != 0:
+        raise ValueError(f"sequence {S} not divisible by seq axis {sp}")
+
+    # Only the seq axis is manual; batch/model axes stay auto (GSPMD
+    # partitions them outside the manual region), so the specs mention
+    # ONLY the manual axis.
+    spec = P(None, axis_name, None, None)
+    fn = jax.shard_map(
+        partial(_ring_attention_local, scale=scale, causal=causal,
+                sp=sp, axis_name=axis_name),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        axis_names={axis_name})
+    return fn(q, k, v)
+
+
+def ring_attention_fn(mesh: Mesh, axis_name: str = SP_AXIS):
+    """AttentionFn adapter for models.transformer (attention_fn plug)."""
+    def attn(q, k, v, mask=None, causal=False, attn_dropout=0.0, rng=None,
+             deterministic=True):
+        if mask is not None or (attn_dropout > 0.0 and not deterministic):
+            raise NotImplementedError(
+                "ring attention supports causal/bidirectional without "
+                "additive masks or attention dropout (match the reference "
+                "posture: dropout lives outside the sp path)")
+        return ring_attention(q, k, v, mesh, causal=causal,
+                              axis_name=axis_name)
+    return attn
