@@ -1,0 +1,73 @@
+"""GPT-2 training example — the Megatron_GPT2 config-matrix analogue.
+
+Pick a ds_config from this directory (ZeRO-2, ZeRO-Offload, 1-bit Adam,
+pipeline) or pass your own. Data is synthetic token streams (no egress);
+plug a real tokenized dataset via --data npy file of int32 [N, S+1].
+
+    python examples/gpt2/train.py --config ds_config_zero2.json --steps 50
+    python examples/gpt2/train.py --config ds_config_offload.json
+    python examples/gpt2/train.py --config ds_config_onebit.json
+    python examples/gpt2/train.py --config ds_config_pipeline.json --pipeline
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+import numpy as np
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2_CONFIGS, gpt2_init, gpt2_loss_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--config", default="ds_config_zero2.json")
+    ap.add_argument("--model", default="gpt2-tiny",
+                    choices=sorted(GPT2_CONFIGS))
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--pipeline", action="store_true")
+    ap.add_argument("--data", default=None, help="npy int32 [N, S+1]")
+    ap.add_argument("--checkpoint_dir", default=None)
+    args = ap.parse_args()
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    cfg_path = args.config if os.path.isabs(args.config) \
+        else os.path.join(here, args.config)
+    with open(cfg_path) as f:
+        ds_config = json.load(f)
+
+    cfg = GPT2_CONFIGS[args.model]
+    if args.pipeline:
+        from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
+        model = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            config=ds_config, model=model)
+    else:
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=gpt2_loss_fn(cfg),
+            model_params=gpt2_init(jax.random.PRNGKey(0), cfg),
+            config=ds_config)
+
+    bs = ds_config["train_batch_size"]
+    S = cfg.max_seq_length
+    if args.data:
+        tokens = np.load(args.data).astype(np.int32)
+    else:
+        rng = np.random.default_rng(0)
+        tokens = rng.integers(0, cfg.vocab_size,
+                              size=(bs * 16, S + 1)).astype(np.int32)
+
+    for step in range(args.steps):
+        lo = (step * bs) % (len(tokens) - bs)
+        loss = engine.train_batch(tokens[lo:lo + bs])
+    print(f"final loss: {float(jax.device_get(loss)):.4f}")
+    if args.checkpoint_dir:
+        engine.save_checkpoint(args.checkpoint_dir)
+
+
+if __name__ == "__main__":
+    main()
