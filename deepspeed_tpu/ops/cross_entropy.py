@@ -23,6 +23,7 @@ vocab-parallel CE loss Megatron uses.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -31,8 +32,13 @@ import jax.numpy as jnp
 # [chunk, vocab] block stays bounded. 512 MB measured fastest on v5e
 # (ablation: 64M/128M/256M/512M/1G -> 88.6/91.4/92.9/93.3/92.7 TFLOPs on
 # the gpt2-large bench); DS_CE_CHUNK_BYTES overrides for tight-memory runs.
-_CHUNK_BYTES = int(__import__("os").environ.get(
-    "DS_CE_CHUNK_BYTES", 512 * 1024 * 1024))
+try:
+    _CHUNK_BYTES = int(os.environ.get("DS_CE_CHUNK_BYTES",
+                                      512 * 1024 * 1024))
+except ValueError as e:
+    raise ValueError(
+        "DS_CE_CHUNK_BYTES must be a plain integer byte count "
+        f"(got {os.environ.get('DS_CE_CHUNK_BYTES')!r})") from e
 
 
 _MAX_CHUNKS = 64    # chunks are Python-unrolled; bound the traced graph

@@ -61,8 +61,11 @@ def main():
         tokens = rng.integers(0, cfg.vocab_size,
                               size=(bs * 16, S + 1)).astype(np.int32)
 
+    assert len(tokens) >= bs, \
+        f"need >= {bs} rows (train_batch_size), got {len(tokens)}"
+    n_windows = max(1, len(tokens) - bs + 1)
     for step in range(args.steps):
-        lo = (step * bs) % (len(tokens) - bs)
+        lo = (step * bs) % n_windows
         loss = engine.train_batch(tokens[lo:lo + bs])
     print(f"final loss: {float(jax.device_get(loss)):.4f}")
     if args.checkpoint_dir:

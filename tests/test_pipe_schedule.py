@@ -99,3 +99,71 @@ class TestTrainSchedule:
                         in_flight -= 1
                 peak = max(peak, in_flight)
             assert peak <= bound, f"stage {sid}: {peak} > {bound}"
+
+
+class TestScheduleExecution:
+    """The 1F1B instruction program EXECUTES and matches plain autodiff —
+    upgrading the schedule from specification to validated semantics
+    (reference pipe/engine.py:1135-1161 interpreter parity)."""
+
+    def _setup(self, P, M, D=6):
+        import jax
+        import jax.numpy as jnp
+
+        def stage_fn(p, x):
+            return x + jnp.tanh(x @ p["w"] + p["b"])
+
+        params = [{"w": jax.random.normal(jax.random.PRNGKey(s), (D, D)) * 0.4,
+                   "b": jnp.zeros((D,))} for s in range(P)]
+        xs = [jax.random.normal(jax.random.PRNGKey(100 + m), (3, D))
+              for m in range(M)]
+        ts = [jax.random.normal(jax.random.PRNGKey(200 + m), (3,))
+              for m in range(M)]
+
+        def loss_fn(y, t):
+            return jnp.mean((y.sum(-1) - t) ** 2)
+
+        return [stage_fn] * P, params, xs, ts, loss_fn
+
+    @pytest.mark.parametrize("P,M", [(2, 4), (3, 5), (4, 4), (1, 3)])
+    def test_1f1b_matches_autodiff(self, P, M):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from deepspeed_tpu.runtime.pipe.schedule import (
+            execute_train_schedule)
+        fns, params, xs, ts, loss_fn = self._setup(P, M)
+        loss, grads = execute_train_schedule(fns, params, xs, ts, loss_fn)
+
+        def full_loss(params):
+            total = 0.0
+            for m in range(M):
+                h = xs[m]
+                for s in range(P):
+                    h = fns[s](params[s], h)
+                total = total + loss_fn(h, ts[m])
+            return total / M
+
+        ref_loss = full_loss(params)
+        ref_grads = jax.grad(full_loss)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-6)
+        for g, rg in zip(grads, ref_grads):
+            for a, b in zip(jax.tree_util.tree_leaves(g),
+                            jax.tree_util.tree_leaves(rg)):
+                np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                           rtol=1e-5, atol=1e-6)
+
+    def test_buffer_overwrite_detected(self):
+        """Shrinking num_pipe_buffers below the 1F1B requirement trips the
+        live-buffer assertion — the memory claim is load-bearing."""
+        import jax.numpy as jnp
+        from deepspeed_tpu.runtime.pipe import schedule as S
+
+        class Tight(S.TrainSchedule):
+            def num_pipe_buffers(self):
+                return 1     # below min(P - s, M)
+
+        fns, params, xs, ts, loss_fn = self._setup(3, 4)
+        with pytest.raises(AssertionError, match="live buffer|recv"):
+            S.execute_train_schedule(fns, params, xs, ts, loss_fn,
+                                     schedule_cls=Tight)
