@@ -510,6 +510,18 @@ def _flash_sparse_vjp_bwd(scale, causal, dropout, res, do):
 _flash_sparse.defvjp(_flash_sparse_vjp_fwd, _flash_sparse_vjp_bwd)
 
 
+def _lut_fits_smem(layout, budget_bytes: int = 384 * 1024) -> bool:
+    """Row+column LUTs must fit TPU scalar memory (~1 MB on v5e; leave
+    headroom). maxnnz is the widest row/column of the layout."""
+    import numpy as np
+    lay = np.asarray(layout) != 0
+    maxn = max(1, int(lay.sum(-1).max()))
+    maxnT = max(1, int(lay.sum(-2).max()))
+    H, nQ, nK = lay.shape
+    bytes_needed = 4 * H * (nQ * (maxn + 1) + nK * (maxnT + 1))
+    return bytes_needed <= budget_bytes
+
+
 def _to_bh(x):
     B, S, nH, D = x.shape
     return x.transpose(0, 2, 1, 3).reshape(B * nH, S, D)
@@ -556,7 +568,17 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     qt, kt, vt = _to_bh(q), _to_bh(k), _to_bh(v)
     if layout is None:
         o = _flash(qt, kt, vt, seed, scale, causal, dropout)
+    elif not isinstance(layout, jax.core.Tracer) and \
+            _lut_fits_smem(layout):
+        # Concrete layout (the normal case): LUT-driven kernels touch only
+        # the live blocks — compute/bandwidth scale with nnz, not S^2
+        # (reference csrc/sparse_attention LUT design; see sparse_flash.py).
+        from .sparse_flash import sparse_flash_attention
+        o = sparse_flash_attention(qt, kt, vt, layout, causal=causal,
+                                   scale=scale, seed=seed, dropout=dropout)
     else:
+        # Traced layout, or LUTs too large for SMEM (e.g. global-attention
+        # rows at huge S make max-nnz ~ nK): full-grid gated kernel.
         o = _flash_sparse(qt, kt, vt, jnp.asarray(layout, jnp.int32),
                           seed, scale, causal, dropout)
     return o.reshape(B, nH, S, D).transpose(0, 2, 1, 3)

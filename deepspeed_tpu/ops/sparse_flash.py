@@ -1,0 +1,355 @@
+"""LUT-driven block-sparse flash attention — only active blocks are touched.
+
+The layout-gated kernel in flash_attention.py iterates the FULL (q,k) block
+grid and gates the compute, so HBM block loads and grid overhead still scale
+O(S^2) — fine for moderate sparsity, useless for long-context layouts where
+<5% of blocks are live. This module is the reference's actual design point
+(csrc/sparse_attention/utils.cpp builds per-row LUTs for the Triton kernels;
+sdd_segment at :14-117): compress the layout into per-q-block lists of
+active k-block indices and drive the Pallas grid with SCALAR-PREFETCH index
+maps, so the kernel only ever loads and computes the live blocks — compute
+and bandwidth scale with nnz, the splash-attention pattern.
+
+Forward and dq iterate the row LUT (active k per q block); dkv iterates the
+column LUT (active q per k block). Padded LUT tail entries repeat a valid
+index (their loads are harmless) and are gated off the accumulators by the
+per-row count.
+
+Dropout composes via the same stateless position hash as the dense kernels
+(flash_attention._dropout_keep) keyed by the ACTUAL block indices read from
+the LUT, so masks agree across fwd/dq/dkv regardless of iteration order.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from .flash_attention import (NEG_INF, _causal_mask, _dropout_keep,
+                              _interpret)
+
+try:
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+
+def build_luts(layout: np.ndarray):
+    """layout [H, nQ, nK] (0/1) -> (lut [H,nQ,maxn], cnt [H,nQ],
+    lutT [H,nK,maxnT], cntT [H,nK]) int32. Pad entries repeat the last
+    valid index (or 0 for empty rows)."""
+    layout = np.asarray(layout) != 0
+    H, nQ, nK = layout.shape
+
+    def one(mask):      # mask [H, R, C] -> (lut, cnt)
+        cnt = mask.sum(-1).astype(np.int32)
+        maxn = max(1, int(cnt.max()))
+        lut = np.zeros(mask.shape[:2] + (maxn,), np.int32)
+        for h in range(mask.shape[0]):
+            for r in range(mask.shape[1]):
+                idx = np.flatnonzero(mask[h, r])
+                if idx.size:
+                    lut[h, r, :idx.size] = idx
+                    lut[h, r, idx.size:] = idx[-1]
+        return lut, cnt
+
+    lut, cnt = one(layout)
+    lutT, cntT = one(layout.transpose(0, 2, 1))
+    return lut, cnt, lutT, cntT
+
+
+# --------------------------------------------------------------------- #
+# Kernels
+# --------------------------------------------------------------------- #
+def _sfwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, seed_ref,
+                 o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                 *, scale, causal, bq, bk, nH, dropout):
+    bh, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+    h = bh % nH
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kj = lut_ref[h, qi, j]
+
+    @pl.when(j < cnt_ref[h, qi])
+    def _compute():
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, bq, bk)
+        m_prev = m_scr[:, 0:1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:, 0:1] = l_scr[:, 0:1] * alpha + \
+            jnp.sum(p, axis=1, keepdims=True)
+        if dropout > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout)), 0.0)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * alpha + pv
+        m_scr[:, 0:1] = m_new
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        l = l_scr[:, 0:1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = jnp.where(
+            l[:, 0] == 0.0, NEG_INF, m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+
+
+def _sdq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                delta_ref, seed_ref, dq_ref, acc_scr,
+                *, scale, causal, bq, bk, nH, dropout):
+    bh, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nj = pl.num_programs(2)
+    h = bh % nH
+
+    @pl.when(j == 0)
+    def _init():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    kj = lut_ref[h, qi, j]
+
+    @pl.when(j < cnt_ref[h, qi])
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, bq, bk)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
+            dp = jnp.where(keep, dp * (1.0 / (1.0 - dropout)), 0.0)
+        ds = p * (dp - delta) * scale
+        acc_scr[:] += jax.lax.dot_general(
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(j == nj - 1)
+    def _finalize():
+        dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
+
+
+def _sdkv_kernel(lutT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                 delta_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
+                 *, scale, causal, bq, bk, nH, dropout):
+    bh, kj, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
+    nt = pl.num_programs(2)
+    h = bh % nH
+
+    @pl.when(t == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    qi = lutT_ref[h, kj, t]
+
+    @pl.when(t < cntT_ref[h, kj])
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, 0][None, :]
+        delta = delta_ref[0, 0][None, :]
+        s2 = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
+        p2 = jnp.exp(s2 - lse)
+        if dropout > 0.0:
+            keep2 = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk,
+                                  dropout, transposed=True)
+            inv = 1.0 / (1.0 - dropout)
+            p2_drop = jnp.where(keep2, p2 * inv, 0.0)
+        else:
+            p2_drop = p2
+        dv_scr[:] += jax.lax.dot_general(
+            p2_drop.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp2 = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp2 = jnp.where(keep2, dp2 * inv, 0.0)
+        ds2 = p2 * (dp2 - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds2.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(t == nt - 1)
+    def _finalize():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+# --------------------------------------------------------------------- #
+# pallas_call wrappers
+# --------------------------------------------------------------------- #
+def _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
+                dropout):
+    BH, S, D = q.shape
+    nQ = S // bq
+    maxn = lut.shape[-1]
+    grid = (BH, nQ, maxn)
+    kernel = functools.partial(_sfwd_kernel, scale=scale, causal=causal,
+                               bq=bq, bk=bk, nH=nH, dropout=dropout)
+    o, lse = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j, lut, cnt: (b, i, 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, j, lut, cnt:
+                             (b, lut[b % nH, i, j], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, j, lut, cnt:
+                             (b, lut[b % nH, i, j], 0)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j, lut, cnt: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq), lambda b, i, j, lut, cnt: (b, 0, i)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, 128), jnp.float32),
+                pltpu.VMEM((bq, D), jnp.float32),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(lut, cnt, q, k, v, seed)
+    return o, lse
+
+
+def _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT, seed, scale,
+                causal, nH, bq, bk, dropout):
+    BH, S, D = q.shape
+    nQ, nK = S // bq, k.shape[1] // bk
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH,1,S]
+
+    dq = pl.pallas_call(
+        functools.partial(_sdq_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nH=nH, dropout=dropout),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nQ, lut.shape[-1]),
+            in_specs=[
+                pl.BlockSpec((1, bq, D), lambda b, i, j, l, c: (b, i, 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, j, l, c: (b, l[b % nH, i, j], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, i, j, l, c: (b, l[b % nH, i, j], 0)),
+                pl.BlockSpec((1, bq, D), lambda b, i, j, l, c: (b, i, 0)),
+                pl.BlockSpec((1, 1, bq), lambda b, i, j, l, c: (b, 0, i)),
+                pl.BlockSpec((1, 1, bq), lambda b, i, j, l, c: (b, 0, i)),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=pl.BlockSpec((1, bq, D),
+                                   lambda b, i, j, l, c: (b, i, 0)),
+            scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)]),
+        out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        interpret=_interpret(),
+    )(lut, cnt, q, k, v, do, lse, delta, seed)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_sdkv_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nH=nH, dropout=dropout),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(BH, nK, lutT.shape[-1]),
+            in_specs=[
+                pl.BlockSpec((1, bq, D),
+                             lambda b, kk, t, l, c: (b, l[b % nH, kk, t], 0)),
+                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, kk, t, l, c: (b, l[b % nH, kk, t], 0)),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, kk, t, l, c: (b, 0, l[b % nH, kk, t])),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, kk, t, l, c: (b, 0, l[b % nH, kk, t])),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, k.shape[1], D), k.dtype),
+            jax.ShapeDtypeStruct((BH, v.shape[1], D), v.dtype),
+        ],
+        interpret=_interpret(),
+    )(lutT, cntT, q, k, v, do, lse, delta, seed)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13))
+def _sparse_flash(q, k, v, lut, cnt, lutT, cntT, seed,
+                  scale, causal, nH, bq, bk, dropout):
+    o, _ = _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
+                       dropout)
+    return o
+
+
+def _sparse_vjp_fwd(q, k, v, lut, cnt, lutT, cntT, seed,
+                    scale, causal, nH, bq, bk, dropout):
+    o, lse = _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
+                         dropout)
+    return o, (q, k, v, lut, cnt, lutT, cntT, seed, o, lse)
+
+
+def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, res, do):
+    q, k, v, lut, cnt, lutT, cntT, seed, o, lse = res
+    dq, dk, dv = _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT,
+                             seed, scale, causal, nH, bq, bk, dropout)
+    return dq, dk, dv, None, None, None, None, None
+
+
+_sparse_flash.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
+
+
+def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
+                           seed=None, dropout: float = 0.0):
+    """q,k,v: [BH, S, D] (batch*heads flattened); layout: CONCRETE
+    [nH, nQ, nK] array. Only the layout's live blocks are loaded/computed."""
+    BH, S, D = q.shape
+    nH = int(layout.shape[0])
+    bq = S // layout.shape[1]
+    bk = k.shape[1] // layout.shape[2]
+    lut, cnt, lutT, cntT = build_luts(np.asarray(layout))
+    seed = jnp.zeros((1, 1), jnp.int32) if seed is None \
+        else jnp.asarray(seed, jnp.int32).reshape(1, 1)
+    return _sparse_flash(q, k, v, jnp.asarray(lut), jnp.asarray(cnt),
+                         jnp.asarray(lutT), jnp.asarray(cntT), seed,
+                         scale, causal, nH, bq, bk, float(dropout))
