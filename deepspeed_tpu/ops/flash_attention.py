@@ -511,14 +511,19 @@ _flash_sparse.defvjp(_flash_sparse_vjp_fwd, _flash_sparse_vjp_bwd)
 
 
 def _lut_fits_smem(layout, budget_bytes: int = 384 * 1024) -> bool:
-    """Row+column LUTs must fit TPU scalar memory (~1 MB on v5e; leave
-    headroom). maxnnz is the widest row/column of the layout."""
+    """Flattened-nnz LUTs must fit TPU scalar memory (~1 MB on v5e; leave
+    headroom), and every row/column must have >=1 active block (else its
+    output block would never be written by the nnz-grid kernel)."""
     import numpy as np
     lay = np.asarray(layout) != 0
-    maxn = max(1, int(lay.sum(-1).max()))
-    maxnT = max(1, int(lay.sum(-2).max()))
-    H, nQ, nK = lay.shape
-    bytes_needed = 4 * H * (nQ * (maxn + 1) + nK * (maxnT + 1))
+    row_cnt = lay.sum(-1)
+    col_cnt = lay.sum(-2)
+    if (row_cnt == 0).any() or (col_cnt == 0).any():
+        return False
+    H = lay.shape[0]
+    nnz = int(lay.reshape(H, -1).sum(-1).max())
+    # qid+kid ([H, NNZ] each) for both orientations + the two nnz vectors.
+    bytes_needed = 4 * H * (4 * nnz + 2)
     return bytes_needed <= budget_bytes
 
 

@@ -1,28 +1,31 @@
 """LUT-driven block-sparse flash attention — only active blocks are touched.
 
-The layout-gated kernel in flash_attention.py iterates the FULL (q,k) block
-grid and gates the compute, so HBM block loads and grid overhead still scale
-O(S^2) — fine for moderate sparsity, useless for long-context layouts where
-<5% of blocks are live. This module is the reference's actual design point
-(csrc/sparse_attention/utils.cpp builds per-row LUTs for the Triton kernels;
-sdd_segment at :14-117): compress the layout into per-q-block lists of
-active k-block indices and drive the Pallas grid with SCALAR-PREFETCH index
-maps, so the kernel only ever loads and computes the live blocks — compute
-and bandwidth scale with nnz, the splash-attention pattern.
+The layout-gated kernels in flash_attention.py iterate the FULL (q,k) block
+grid and gate the compute, so HBM loads and grid overhead still scale
+O(S^2). This module is the reference's actual design point
+(csrc/sparse_attention/utils.cpp builds LUTs for its Triton kernels,
+sdd_segment :14-117), taken to the splash-attention form: the layout
+flattens into ONE list of active (q-block, k-block) pairs per head, and the
+Pallas grid iterates exactly those nnz steps — scalar-prefetch index maps
+pick each step's blocks, the online-softmax state resets on q-row
+transitions, and the output block flushes when the row advances. Compute,
+bandwidth, AND grid steps all scale with nnz; there is no padding to the
+widest row (global-attention rows cost only their own entries).
 
-Forward and dq iterate the row LUT (active k per q block); dkv iterates the
-column LUT (active q per k block). Padded LUT tail entries repeat a valid
-index (their loads are harmless) and are gated off the accumulators by the
-per-row count.
+Forward and dq iterate the row-major pair list; dkv iterates the
+column-major list (state carried per k block). Dropout composes via the
+same stateless position hash as the dense kernels (keyed by the ACTUAL
+block indices read from the LUT), so masks agree across fwd/dq/dkv.
 
-Dropout composes via the same stateless position hash as the dense kernels
-(flash_attention._dropout_keep) keyed by the ACTUAL block indices read from
-the LUT, so masks agree across fwd/dq/dkv regardless of iteration order.
+Requirement: every q-block row and k-block column of the layout must have
+at least one active block (else its output block would never be written);
+``build_flat_luts`` returns None in that case and the caller falls back to
+the gated kernel.
 """
 from __future__ import annotations
 
 import functools
-from typing import Tuple
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,49 +41,54 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def build_luts(layout: np.ndarray):
-    """layout [H, nQ, nK] (0/1) -> (lut [H,nQ,maxn], cnt [H,nQ],
-    lutT [H,nK,maxnT], cntT [H,nK]) int32. Pad entries repeat the last
-    valid index (or 0 for empty rows)."""
-    layout = np.asarray(layout) != 0
-    H, nQ, nK = layout.shape
+def build_flat_luts(layout: np.ndarray):
+    """layout [H, nQ, nK] -> (qid, kid, nnz, qidT, kidT, nnzT) int32 arrays
+    ([H, NNZ] / [H]), row-major for fwd/dq and column-major for dkv; padded
+    tails repeat the last pair. None if any row/column is empty."""
+    lay = np.asarray(layout) != 0
+    H, nQ, nK = lay.shape
+    if (lay.sum(-1) == 0).any() or (lay.sum(-2) == 0).any():
+        return None
 
-    def one(mask):      # mask [H, R, C] -> (lut, cnt)
-        cnt = mask.sum(-1).astype(np.int32)
-        maxn = max(1, int(cnt.max()))
-        lut = np.zeros(mask.shape[:2] + (maxn,), np.int32)
-        for h in range(mask.shape[0]):
-            for r in range(mask.shape[1]):
-                idx = np.flatnonzero(mask[h, r])
-                if idx.size:
-                    lut[h, r, :idx.size] = idx
-                    lut[h, r, idx.size:] = idx[-1]
-        return lut, cnt
+    def flatten(mask):      # row-major active pairs per head
+        pairs = [np.argwhere(mask[h]) for h in range(H)]
+        nnz = np.asarray([len(p) for p in pairs], np.int32)
+        NNZ = int(nnz.max())
+        rid = np.zeros((H, NNZ), np.int32)
+        cid = np.zeros((H, NNZ), np.int32)
+        for h, p in enumerate(pairs):
+            rid[h, :len(p)] = p[:, 0]
+            cid[h, :len(p)] = p[:, 1]
+            rid[h, len(p):] = p[-1, 0]
+            cid[h, len(p):] = p[-1, 1]
+        return rid, cid, nnz
 
-    lut, cnt = one(layout)
-    lutT, cntT = one(layout.transpose(0, 2, 1))
-    return lut, cnt, lutT, cntT
+    qid, kid, nnz = flatten(lay)
+    kidT, qidT, nnzT = flatten(lay.transpose(0, 2, 1))
+    return qid, kid, nnz, qidT, kidT, nnzT
 
 
 # --------------------------------------------------------------------- #
-# Kernels
+# Kernels — grid (BH, NNZ); state carries across same-row steps
 # --------------------------------------------------------------------- #
-def _sfwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, seed_ref,
+def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, seed_ref,
                  o_ref, lse_ref, m_scr, l_scr, acc_scr,
                  *, scale, causal, bq, bk, nH, dropout):
-    bh, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nj = pl.num_programs(2)
+    bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
+    qi = qid_ref[h, n]
+    kj = kid_ref[h, n]
+    prev_qi = qid_ref[h, jnp.maximum(n - 1, 0)]
+    new_row = jnp.logical_or(n == 0, qi != prev_qi)
+    active = n < nnz_ref[h]
 
-    @pl.when(j == 0)
+    @pl.when(jnp.logical_and(new_row, active))
     def _init():
         m_scr[:] = jnp.full_like(m_scr, NEG_INF)
         l_scr[:] = jnp.zeros_like(l_scr)
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    kj = lut_ref[h, qi, j]
-
-    @pl.when(j < cnt_ref[h, qi])
+    @pl.when(active)
     def _compute():
         q, k, v = q_ref[0], k_ref[0], v_ref[0]
         s = jax.lax.dot_general(
@@ -92,8 +100,7 @@ def _sfwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, seed_ref,
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_scr[:, 0:1] = l_scr[:, 0:1] * alpha + \
-            jnp.sum(p, axis=1, keepdims=True)
+        l_new = l_scr[:, 0:1] * alpha + jnp.sum(p, axis=1, keepdims=True)
         if dropout > 0.0:
             keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
             p = jnp.where(keep, p * (1.0 / (1.0 - dropout)), 0.0)
@@ -102,30 +109,31 @@ def _sfwd_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, seed_ref,
             preferred_element_type=jnp.float32)
         acc_scr[:] = acc_scr[:] * alpha + pv
         m_scr[:, 0:1] = m_new
+        l_scr[:, 0:1] = l_new
 
-    @pl.when(j == nj - 1)
-    def _finalize():
-        l = l_scr[:, 0:1]
-        l_safe = jnp.where(l == 0.0, 1.0, l)
+        # Running finalize: the LAST write before the row index advances is
+        # what flushes to HBM — the per-row final value by construction.
+        l_safe = jnp.where(l_new == 0.0, 1.0, l_new)
         o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = jnp.where(
-            l[:, 0] == 0.0, NEG_INF, m_scr[:, 0] + jnp.log(l_safe[:, 0]))
+        lse_ref[0, 0] = m_new[:, 0] + jnp.log(l_safe[:, 0])
 
 
-def _sdq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                delta_ref, seed_ref, dq_ref, acc_scr,
+def _sdq_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref,
+                lse_ref, delta_ref, seed_ref, dq_ref, acc_scr,
                 *, scale, causal, bq, bk, nH, dropout):
-    bh, qi, j = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nj = pl.num_programs(2)
+    bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
+    qi = qid_ref[h, n]
+    kj = kid_ref[h, n]
+    prev_qi = qid_ref[h, jnp.maximum(n - 1, 0)]
+    new_row = jnp.logical_or(n == 0, qi != prev_qi)
+    active = n < nnz_ref[h]
 
-    @pl.when(j == 0)
+    @pl.when(jnp.logical_and(new_row, active))
     def _init():
         acc_scr[:] = jnp.zeros_like(acc_scr)
 
-    kj = lut_ref[h, qi, j]
-
-    @pl.when(j < cnt_ref[h, qi])
+    @pl.when(active)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = lse_ref[0, 0][:, None]
@@ -146,27 +154,26 @@ def _sdq_kernel(lut_ref, cnt_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         acc_scr[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-
-    @pl.when(j == nj - 1)
-    def _finalize():
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _sdkv_kernel(lutT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
-                 delta_ref, seed_ref, dk_ref, dv_ref, dk_scr, dv_scr,
-                 *, scale, causal, bq, bk, nH, dropout):
-    bh, kj, t = pl.program_id(0), pl.program_id(1), pl.program_id(2)
-    nt = pl.num_programs(2)
+def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, q_ref, k_ref, v_ref, do_ref,
+                 lse_ref, delta_ref, seed_ref, dk_ref, dv_ref,
+                 dk_scr, dv_scr, *, scale, causal, bq, bk, nH, dropout):
+    bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
+    kj = kidT_ref[h, n]
+    qi = qidT_ref[h, n]
+    prev_kj = kidT_ref[h, jnp.maximum(n - 1, 0)]
+    new_col = jnp.logical_or(n == 0, kj != prev_kj)
+    active = n < nnzT_ref[h]
 
-    @pl.when(t == 0)
+    @pl.when(jnp.logical_and(new_col, active))
     def _init():
         dk_scr[:] = jnp.zeros_like(dk_scr)
         dv_scr[:] = jnp.zeros_like(dv_scr)
 
-    qi = lutT_ref[h, kj, t]
-
-    @pl.when(t < cntT_ref[h, kj])
+    @pl.when(active)
     def _compute():
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         lse = lse_ref[0, 0][None, :]
@@ -196,9 +203,6 @@ def _sdkv_kernel(lutT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         dk_scr[:] += jax.lax.dot_general(
             ds2.astype(q.dtype), q, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-
-    @pl.when(t == nt - 1)
-    def _finalize():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
@@ -206,32 +210,36 @@ def _sdkv_kernel(lutT_ref, cntT_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
 # --------------------------------------------------------------------- #
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
-def _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
+def _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH, bq, bk,
                 dropout):
     BH, S, D = q.shape
-    nQ = S // bq
-    maxn = lut.shape[-1]
-    grid = (BH, nQ, maxn)
+    NNZ = qid.shape[-1]
     kernel = functools.partial(_sfwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nH=nH, dropout=dropout)
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=grid,
+            num_scalar_prefetch=3,
+            grid=(BH, NNZ),
             in_specs=[
-                pl.BlockSpec((1, bq, D), lambda b, i, j, lut, cnt: (b, i, 0)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, qid, kid, nnz:
+                             (b, qid[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, i, j, lut, cnt:
-                             (b, lut[b % nH, i, j], 0)),
+                             lambda b, n, qid, kid, nnz:
+                             (b, kid[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, i, j, lut, cnt:
-                             (b, lut[b % nH, i, j], 0)),
+                             lambda b, n, qid, kid, nnz:
+                             (b, kid[b % nH, n], 0)),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
-                pl.BlockSpec((1, bq, D), lambda b, i, j, lut, cnt: (b, i, 0)),
-                pl.BlockSpec((1, 1, bq), lambda b, i, j, lut, cnt: (b, 0, i)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, qid, kid, nnz:
+                             (b, qid[b % nH, n], 0)),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, n, qid, kid, nnz:
+                             (b, 0, qid[b % nH, n])),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bq, 128), jnp.float32),
@@ -243,14 +251,14 @@ def _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         interpret=_interpret(),
-    )(lut, cnt, q, k, v, seed)
+    )(qid, kid, nnz, q, k, v, seed)
     return o, lse
 
 
-def _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT, seed, scale,
-                causal, nH, bq, bk, dropout):
+def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
+                dropout):
+    qid, kid, nnz, qidT, kidT, nnzT = luts
     BH, S, D = q.shape
-    nQ, nK = S // bq, k.shape[1] // bk
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH,1,S]
 
@@ -258,48 +266,56 @@ def _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT, seed, scale,
         functools.partial(_sdq_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nH=nH, dropout=dropout),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(BH, nQ, lut.shape[-1]),
+            num_scalar_prefetch=3,
+            grid=(BH, qid.shape[-1]),
             in_specs=[
-                pl.BlockSpec((1, bq, D), lambda b, i, j, l, c: (b, i, 0)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, i, j, l, c: (b, l[b % nH, i, j], 0)),
+                             lambda b, n, qi, ki, nz: (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, i, j, l, c: (b, l[b % nH, i, j], 0)),
-                pl.BlockSpec((1, bq, D), lambda b, i, j, l, c: (b, i, 0)),
-                pl.BlockSpec((1, 1, bq), lambda b, i, j, l, c: (b, 0, i)),
-                pl.BlockSpec((1, 1, bq), lambda b, i, j, l, c: (b, 0, i)),
+                             lambda b, n, qi, ki, nz: (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, n, qi, ki, nz: (b, 0, qi[b % nH, n])),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, n, qi, ki, nz: (b, 0, qi[b % nH, n])),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
-            out_specs=pl.BlockSpec((1, bq, D),
-                                   lambda b, i, j, l, c: (b, i, 0)),
+            out_specs=pl.BlockSpec(
+                (1, bq, D), lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         interpret=_interpret(),
-    )(lut, cnt, q, k, v, do, lse, delta, seed)
+    )(qid, kid, nnz, q, k, v, do, lse, delta, seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_sdkv_kernel, scale=scale, causal=causal,
                           bq=bq, bk=bk, nH=nH, dropout=dropout),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=2,
-            grid=(BH, nK, lutT.shape[-1]),
+            num_scalar_prefetch=3,
+            grid=(BH, kidT.shape[-1]),
             in_specs=[
                 pl.BlockSpec((1, bq, D),
-                             lambda b, kk, t, l, c: (b, l[b % nH, kk, t], 0)),
-                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
-                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+                             lambda b, n, ki, qi, nz: (b, qi[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bq, D),
-                             lambda b, kk, t, l, c: (b, l[b % nH, kk, t], 0)),
+                             lambda b, n, ki, qi, nz: (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, kk, t, l, c: (b, 0, l[b % nH, kk, t])),
+                             lambda b, n, ki, qi, nz: (b, 0, qi[b % nH, n])),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, kk, t, l, c: (b, 0, l[b % nH, kk, t])),
+                             lambda b, n, ki, qi, nz: (b, 0, qi[b % nH, n])),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
-                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
-                pl.BlockSpec((1, bk, D), lambda b, kk, t, l, c: (b, kk, 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bk, D), jnp.float32),
@@ -310,30 +326,31 @@ def _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT, seed, scale,
             jax.ShapeDtypeStruct((BH, v.shape[1], D), v.dtype),
         ],
         interpret=_interpret(),
-    )(lutT, cntT, q, k, v, do, lse, delta, seed)
+    )(kidT, qidT, nnzT, q, k, v, do, lse, delta, seed)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(8, 9, 10, 11, 12, 13))
-def _sparse_flash(q, k, v, lut, cnt, lutT, cntT, seed,
+@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14, 15))
+def _sparse_flash(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
                   scale, causal, nH, bq, bk, dropout):
-    o, _ = _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
-                       dropout)
+    o, _ = _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH,
+                       bq, bk, dropout)
     return o
 
 
-def _sparse_vjp_fwd(q, k, v, lut, cnt, lutT, cntT, seed,
+def _sparse_vjp_fwd(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
                     scale, causal, nH, bq, bk, dropout):
-    o, lse = _sparse_fwd(q, k, v, lut, cnt, seed, scale, causal, nH, bq, bk,
-                         dropout)
-    return o, (q, k, v, lut, cnt, lutT, cntT, seed, o, lse)
+    o, lse = _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH,
+                         bq, bk, dropout)
+    return o, (q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed, o, lse)
 
 
 def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, res, do):
-    q, k, v, lut, cnt, lutT, cntT, seed, o, lse = res
-    dq, dk, dv = _sparse_bwd(q, k, v, o, lse, do, lut, cnt, lutT, cntT,
-                             seed, scale, causal, nH, bq, bk, dropout)
-    return dq, dk, dv, None, None, None, None, None
+    q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed, o, lse = res
+    dq, dk, dv = _sparse_bwd(q, k, v, o, lse, do,
+                             (qid, kid, nnz, qidT, kidT, nnzT), seed,
+                             scale, causal, nH, bq, bk, dropout)
+    return (dq, dk, dv) + (None,) * 7
 
 
 _sparse_flash.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
@@ -342,14 +359,17 @@ _sparse_flash.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
 def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
                            seed=None, dropout: float = 0.0):
     """q,k,v: [BH, S, D] (batch*heads flattened); layout: CONCRETE
-    [nH, nQ, nK] array. Only the layout's live blocks are loaded/computed."""
+    [nH, nQ, nK] array with no empty rows/columns. Grid steps == nnz."""
     BH, S, D = q.shape
     nH = int(layout.shape[0])
     bq = S // layout.shape[1]
     bk = k.shape[1] // layout.shape[2]
-    lut, cnt, lutT, cntT = build_luts(np.asarray(layout))
+    luts = build_flat_luts(np.asarray(layout))
+    if luts is None:
+        raise ValueError("layout has an empty row/column; caller should "
+                         "use the gated kernel")
+    qid, kid, nnz, qidT, kidT, nnzT = (jnp.asarray(a) for a in luts)
     seed = jnp.zeros((1, 1), jnp.int32) if seed is None \
         else jnp.asarray(seed, jnp.int32).reshape(1, 1)
-    return _sparse_flash(q, k, v, jnp.asarray(lut), jnp.asarray(cnt),
-                         jnp.asarray(lutT), jnp.asarray(cntT), seed,
+    return _sparse_flash(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
                          scale, causal, nH, bq, bk, float(dropout))
