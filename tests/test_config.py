@@ -141,3 +141,28 @@ class TestMisc:
                         "progressive_layer_drop": {"enabled": True, "gamma": 0.01}})
         assert cfg.pld_config.enabled
         assert cfg.pld_config.gamma == 0.01
+
+
+class TestExampleConfigs:
+    def test_all_example_configs_parse(self):
+        """examples/ ship runnable ds_configs; keep them valid against the
+        config system (batch triple, known keys)."""
+        import glob
+        import json
+        import os
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+        here = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        paths = glob.glob(os.path.join(here, "examples", "**", "*.json"),
+                          recursive=True)
+        assert paths, "no example configs found"
+        for p in paths:
+            with open(p) as f:
+                d = json.load(f)
+            world = 1
+            if "mesh" in d:
+                world = (d["mesh"].get("pipe_parallel_size", 1) or 1) * 4
+            cfg = DeepSpeedConfig(d, world_size=max(
+                1, d["train_batch_size"] //
+                (d["train_micro_batch_size_per_gpu"] *
+                 d.get("gradient_accumulation_steps", 1))))
+            assert cfg.train_batch_size == d["train_batch_size"], p
