@@ -57,6 +57,15 @@ def pick_chunks(n_tokens: int, vocab: int) -> int:
             best = c
             if c >= target:
                 return c
+    if target > 1:
+        import logging
+        logging.getLogger(__name__).warning(
+            "chunked cross-entropy: n_tokens=%d has no divisor >= %d under "
+            "%d chunks; falling back to %d chunk(s) — the full [%d, %d] "
+            "fp32 logits block (%.1f MB) will materialize. Pad the token "
+            "dim to a rounder multiple to restore the memory bound.",
+            n_tokens, target, _MAX_CHUNKS, best, n_tokens // best, vocab,
+            (n_tokens // best) * vocab * 4 / 2**20)
     return best
 
 

@@ -49,16 +49,36 @@ def init_state(params: Any) -> OnebitState:
                        worker_error=zeros(), server_error=zeros())
 
 
-def _compress(x: jnp.ndarray, error: jnp.ndarray):
+def _compress(x: jnp.ndarray, error: jnp.ndarray, chunks: int = 1):
     """Error-feedback 1-bit compression of one tensor.
 
     compensated = x + error; transmitted = scale * sign(compensated) with
-    scale = mean |compensated| (the L1 scale the reference uses per chunk);
-    new_error = compensated - transmitted. Returns (transmitted, new_error).
+    one L1 scale (mean |compensated|) PER CHUNK, matching the reference's
+    per-worker-chunk scaling (onebit_adam.py splits the flat tensor into
+    world_size chunks and scales each independently, :141-168). ``chunks``
+    should be the dp degree; tensors smaller than ``chunks`` elements fall
+    back to a single scale. new_error = compensated - transmitted.
+    Returns (transmitted, new_error).
     """
     compensated = x + error
-    scale = jnp.mean(jnp.abs(compensated))
-    transmitted = scale * jnp.sign(compensated)
+    if chunks <= 1 or compensated.size < chunks:
+        scale = jnp.mean(jnp.abs(compensated))
+        transmitted = scale * jnp.sign(compensated)
+        return transmitted, compensated - transmitted
+    flat = compensated.reshape(-1)
+    n = flat.shape[0]
+    pad = (-n) % chunks
+    rows = jnp.pad(flat, (0, pad)).reshape(chunks, -1)
+    # Per-chunk L1 scale over the REAL elements only: padded zeros add
+    # nothing to the |.| sum, so divide by the true per-chunk count. Padding
+    # can span several trailing chunks (tiny tensors at high dp); the count
+    # floor of 1 keeps all-pad rows finite (they transmit sign(0)=0 anyway).
+    width = rows.shape[1]
+    counts = jnp.clip(n - jnp.arange(chunks, dtype=jnp.float32) * width,
+                      1.0, float(width))
+    scale = jnp.sum(jnp.abs(rows), axis=1) / counts
+    transmitted = (scale[:, None] * jnp.sign(rows)).reshape(-1)[:n]
+    transmitted = transmitted.reshape(x.shape)
     return transmitted, compensated - transmitted
 
 
@@ -75,9 +95,10 @@ def _tree_sumsq(g):
 def onebit_adam_update(grads_local: Any, state: OnebitState, params: Any,
                        *, lr, b1: float = 0.9, b2: float = 0.999,
                        eps: float = 1e-8, weight_decay: float = 0.0,
-                       freeze_step: int = 100,
+                       freeze_step: int = 100000,
                        axis_name: Optional[str] = None,
-                       dp: int = 1, clip: float = 0.0):
+                       dp: int = 1, clip: float = 0.0,
+                       loss_scale=None):
     """One 1-bit Adam step. Must run where ``lax.psum(axis_name)`` is legal
     (inside shard_map / pmap over the dp axis) when dp > 1; ``grads_local``
     are the rank-LOCAL unreduced gradients.
@@ -86,14 +107,31 @@ def onebit_adam_update(grads_local: Any, state: OnebitState, params: Any,
     dp-averaged gradient (identical to the standard engine's clipping); in
     the compression stage the RMS of per-rank local norms (the global
     gradient is never materialized there — that is the point), which
-    over-estimates and therefore clips conservatively.
+    over-estimates and therefore clips conservatively. The same quantity is
+    the reported ``grad_norm``.
 
-    Returns (new_params, new_state).
+    ``loss_scale`` (fp16 static scaling): grads_local are assumed to be
+    grads of ``loss * loss_scale``; they are unscaled in fp32 here.
+
+    Overflow semantics (reference onebit_adam.py keeps the fp16 overflow
+    machinery through the compression phase): if any rank's gradient is
+    non-finite the step is SKIPPED — params, m, v, both error buffers and
+    the Adam step count are all left untouched, in both phases. In the
+    compressed phase this matters doubly: committing error feedback from a
+    garbage momentum would poison every subsequent step.
+
+    Returns ``(new_params, new_state, aux)`` with
+    ``aux = {"grad_norm": f32, "overflow": bool}``.
     """
     def psum_mean(t):
         if axis_name is None or dp <= 1:
             return t
         return lax.psum(t, axis_name) / dp
+
+    if loss_scale is not None:
+        inv = 1.0 / loss_scale
+        grads_local = jax.tree_util.tree_map(
+            lambda g: g.astype(jnp.float32) * inv, grads_local)
 
     step = state.step + 1
     in_warmup = step <= freeze_step
@@ -102,8 +140,9 @@ def onebit_adam_update(grads_local: Any, state: OnebitState, params: Any,
         # Standard (bias-corrected) Adam on the full-precision psum'd grads
         # — reference warmup phase.
         g = jax.tree_util.tree_map(psum_mean, grads_local)
+        norm = jnp.sqrt(_tree_sumsq(g))
         if clip and clip > 0:
-            g = _clip_tree(g, clip, jnp.sqrt(_tree_sumsq(g)))
+            g = _clip_tree(g, clip, norm)
         m = jax.tree_util.tree_map(
             lambda mm, gg: b1 * mm + (1 - b1) * gg, state.m, g)
         v = jax.tree_util.tree_map(
@@ -112,22 +151,22 @@ def onebit_adam_update(grads_local: Any, state: OnebitState, params: Any,
         bc2 = 1 - b2 ** step.astype(jnp.float32)
         upd = jax.tree_util.tree_map(
             lambda mm, vv: (mm / bc1) / (jnp.sqrt(vv / bc2) + eps), m, v)
-        return m, v, state.worker_error, state.server_error, upd
+        return m, v, state.worker_error, state.server_error, upd, norm
 
     def compressed(_):
         # Local momentum update with LOCAL grads, then the two-phase
         # error-feedback compressed allreduce; variance frozen.
         g_local = grads_local
+        norm = jnp.sqrt(psum_mean(_tree_sumsq(g_local)))
         if clip and clip > 0:
-            sumsq = psum_mean(_tree_sumsq(g_local))
-            g_local = _clip_tree(g_local, clip, jnp.sqrt(sumsq))
+            g_local = _clip_tree(g_local, clip, norm)
         m_local = jax.tree_util.tree_map(
             lambda mm, gg: b1 * mm + (1 - b1) * gg, state.m, g_local)
 
         def comm(mm, werr, serr):
-            sent, new_werr = _compress(mm, werr)           # worker side
-            gathered = psum_mean(sent)                     # "igather+avg"
-            final, new_serr = _compress(gathered, serr)    # server side
+            sent, new_werr = _compress(mm, werr, chunks=dp)  # worker side
+            gathered = psum_mean(sent)                       # "igather+avg"
+            final, new_serr = _compress(gathered, serr, chunks=dp)  # server
             return final, new_werr, new_serr
 
         out = jax.tree_util.tree_map(comm, m_local, state.worker_error,
@@ -142,17 +181,31 @@ def onebit_adam_update(grads_local: Any, state: OnebitState, params: Any,
             treedef, [l[2] for l in leaves])
         upd = jax.tree_util.tree_map(
             lambda mm, vv: mm / (jnp.sqrt(vv) + eps), m_new, state.v)
-        return m_new, state.v, werr, serr, upd
+        return m_new, state.v, werr, serr, upd, norm
 
-    m, v, werr, serr, upd = lax.cond(in_warmup, warmup, compressed, None)
+    m, v, werr, serr, upd, norm = lax.cond(in_warmup, warmup, compressed,
+                                           None)
+
+    # Overflow vote: the norm folds every leaf on every rank (psum'd), so a
+    # single non-finite grad anywhere makes it non-finite. Skip = identity.
+    overflow = ~jnp.isfinite(norm)
+
+    def commit(old, new):
+        return jax.tree_util.tree_map(
+            lambda o, n: jnp.where(overflow, o, n), old, new)
 
     new_params = jax.tree_util.tree_map(
         lambda p, u: (p.astype(jnp.float32) - lr * (u + weight_decay *
                                                     p.astype(jnp.float32))
                       ).astype(p.dtype),
         params, upd)
-    return new_params, OnebitState(step=step, m=m, v=v, worker_error=werr,
-                                   server_error=serr)
+    new_state = OnebitState(
+        step=jnp.where(overflow, state.step, step),
+        m=commit(state.m, m), v=commit(state.v, v),
+        worker_error=commit(state.worker_error, werr),
+        server_error=commit(state.server_error, serr))
+    return commit(params, new_params), new_state, \
+        {"grad_norm": norm, "overflow": overflow}
 
 
 def comm_bytes(n_elements: int, *, compressed: bool,

@@ -184,10 +184,11 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "OnebitAdam composes with ZeRO stage 0 only (reference: "
                     "it is an fp16-wrapper-level optimizer, not a ZeRO one)")
-            if self.config.fp16_enabled:
-                raise NotImplementedError(
-                    "OnebitAdam on TPU runs bf16/fp32 (no dynamic loss "
-                    "scale in the compressed step); use bf16")
+            # fp16 composes: the loss-scale machinery (static or dynamic)
+            # runs through BOTH phases, like the reference's OnebitAdam
+            # which keeps overflow checks during compression
+            # (onebit_adam.py:104-228). Overflow skips the step without
+            # committing error feedback (ops/onebit.py).
             if param_shardings is not None:
                 raise NotImplementedError(
                     "OnebitAdam + tensor-parallel param_shardings: the "
@@ -645,8 +646,13 @@ class DeepSpeedEngine:
         clip = self.gradient_clipping()
         dp, mesh = self.dp_size, self.mesh
         pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
+        fp16 = self.config.fp16_enabled
+        static_scale = self._static_loss_scale
+        scale_window = self._scale_window
+        min_scale = self._min_scale
+        hysteresis_init = self._hysteresis
 
-        def per_rank(params, opt_state, step, micro_batches, keys):
+        def per_rank(params, opt_state, step, scale, micro_batches, keys):
             # worker_error arrives [1, ...] (its dp axis split by shard_map)
             opt_state = opt_state._replace(
                 worker_error=jax.tree_util.tree_map(
@@ -671,19 +677,23 @@ class DeepSpeedEngine:
 
                 total, _ = lax.scan(one_micro, jnp.asarray(0.0, jnp.float32),
                                     (micro_batches, keys))
-                return total
+                return total * scale if fp16 else total
 
             loss_val, grads = jax.value_and_grad(mean_loss_fn)(params)
+            if fp16:
+                loss_val = loss_val / scale
             lr = schedule_fn(step)
-            new_params, new_opt = onebit_adam_update(
+            new_params, new_opt, aux = onebit_adam_update(
                 grads, opt_state, params, lr=lr, b1=b1, b2=b2, eps=eps,
                 weight_decay=wd, freeze_step=freeze_step,
-                axis_name=DP_AXIS if dp > 1 else None, dp=dp, clip=clip)
+                axis_name=DP_AXIS if dp > 1 else None, dp=dp, clip=clip,
+                loss_scale=scale if fp16 else None)
             new_opt = new_opt._replace(
                 worker_error=jax.tree_util.tree_map(
                     lambda w: w[None], new_opt.worker_error))
             loss_out = lax.psum(loss_val, DP_AXIS) / dp if dp > 1 else loss_val
-            return new_params, new_opt, loss_out, lr
+            return (new_params, new_opt, loss_out, lr,
+                    aux["grad_norm"], aux["overflow"])
 
         def train_step(state: EngineState, micro_batches, rng):
             rng = jax.random.fold_in(rng, state.step)
@@ -701,19 +711,41 @@ class DeepSpeedEngine:
                     server_error=P())
                 fn = shard_map(
                     per_rank, mesh=mesh,
-                    in_specs=(P(), opt_specs, P(), batch_specs, P()),
-                    out_specs=(P(), opt_specs, P(), P()),
+                    in_specs=(P(), opt_specs, P(), P(), batch_specs, P()),
+                    out_specs=(P(), opt_specs, P(), P(), P(), P()),
                     check_vma=False)
             else:
                 fn = per_rank
-            new_params, new_opt, loss, lr = fn(
-                state.params, state.opt_state, state.step, micro_batches,
-                keys)
-            new_state = state.replace(step=state.step + 1, params=new_params,
-                                      opt_state=new_opt)
-            metrics = {"loss": loss, "grad_norm": jnp.asarray(-1.0),
-                       "lr": lr, "loss_scale": jnp.asarray(1.0),
-                       "overflow": jnp.asarray(False)}
+            new_params, new_opt, loss, lr, gnorm, overflow = fn(
+                state.params, state.opt_state, state.step, state.loss_scale,
+                micro_batches, keys)
+            if fp16 and not static_scale:
+                ls = LossScaleState(
+                    loss_scale=state.loss_scale,
+                    growth_count=state.growth_count,
+                    hysteresis=state.hysteresis, dynamic=True,
+                    scale_window=scale_window, min_scale=min_scale,
+                    hysteresis_init=hysteresis_init, scale_factor=2.0)
+                ls = update_loss_scale(ls, overflow)
+                scale_next, growth, hyst = (ls.loss_scale, ls.growth_count,
+                                            ls.hysteresis)
+            else:
+                scale_next, growth, hyst = (state.loss_scale,
+                                            state.growth_count,
+                                            state.hysteresis)
+            # Overflow-skip parity with the main path: hold step (LR holds),
+            # count the skip. Params/opt already held inside the update.
+            new_step = state.step + jnp.where(overflow, 0, 1).astype(jnp.int32)
+            new_state = state.replace(step=new_step, params=new_params,
+                                      opt_state=new_opt,
+                                      loss_scale=scale_next,
+                                      growth_count=growth, hysteresis=hyst,
+                                      skipped_steps=state.skipped_steps +
+                                      jnp.where(overflow, 1, 0)
+                                      .astype(jnp.int32))
+            metrics = {"loss": loss, "grad_norm": gnorm,
+                       "lr": lr, "loss_scale": state.loss_scale,
+                       "overflow": overflow}
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,))
@@ -890,8 +922,12 @@ class DeepSpeedEngine:
         gas = self._scan_microbatches()
         for x in jax.tree_util.tree_leaves(batch):
             lead = getattr(x, "shape", (0,))[0] if getattr(x, "ndim", 1) else 0
-            assert lead % gas == 0, \
-                f"batch dim {lead} not divisible by grad-accum {gas}"
+            if lead % gas != 0:
+                # ValueError, not assert: under ``python -O`` an assert is
+                # stripped and the in-jit reshape fails with an opaque XLA
+                # shape error instead.
+                raise ValueError(
+                    f"batch dim {lead} not divisible by grad-accum {gas}")
 
     def _stack_micro_batches(self, batch):
         """Reshape to [gas, per_micro_step, ...]. Device arrays stay on
@@ -903,8 +939,9 @@ class DeepSpeedEngine:
             if not isinstance(x, (jax.Array, np.ndarray)):
                 x = np.asarray(x)
             lead = x.shape[0]
-            assert lead % gas == 0, \
-                f"batch dim {lead} not divisible by grad-accum {gas}"
+            if lead % gas != 0:
+                raise ValueError(
+                    f"batch dim {lead} not divisible by grad-accum {gas}")
             return x.reshape((gas, lead // gas) + x.shape[1:])
         return jax.tree_util.tree_map(reshape, batch)
 

@@ -69,6 +69,13 @@ class PipelineEngine(DeepSpeedEngine):
             # remat_policy). Key absent -> remat stays ON (the memory-safe
             # default this pipeline has always had).
             interval = self._peek_actckpt_interval(config)
+            if interval is not None and interval > 1:
+                log_dist(
+                    f"pipeline.activation_checkpoint_interval={interval} > 1 "
+                    "is coarsened to stage-granularity remat on TPU (the "
+                    "reference checkpoints every N layers; here the compiled "
+                    "stage is the remat unit — use the model's remat_policy "
+                    "for per-layer control)", ranks=[0])
             loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh,
                                     remat=interval != 0)
             super().__init__(args=args, model=loss_fn, optimizer=optimizer,

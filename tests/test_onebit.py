@@ -37,7 +37,7 @@ def test_warmup_matches_plain_adam():
         g = jax.tree_util.tree_map(
             lambda p: jnp.asarray(
                 rng.standard_normal(p.shape).astype(np.float32)), params)
-        params, st = onebit_adam_update(g, st, params, lr=lr, b1=b1, b2=b2,
+        params, st, _ = onebit_adam_update(g, st, params, lr=lr, b1=b1, b2=b2,
                                         eps=eps, freeze_step=100)
         u, ref_st = tx.update(g, ref_st, ref)
         ref = optax.apply_updates(ref, u)
@@ -55,11 +55,11 @@ def test_variance_frozen_after_warmup():
         lambda p: jnp.asarray(rng.standard_normal(p.shape).astype(np.float32)),
         params)
     for _ in range(3):
-        params, st = onebit_adam_update(mk_g(), st, params, lr=1e-3,
+        params, st, _ = onebit_adam_update(mk_g(), st, params, lr=1e-3,
                                         freeze_step=3)
     v_frozen = jax.tree_util.tree_map(np.asarray, st.v)
     for _ in range(5):
-        params, st = onebit_adam_update(mk_g(), st, params, lr=1e-3,
+        params, st, _ = onebit_adam_update(mk_g(), st, params, lr=1e-3,
                                         freeze_step=3)
     for a, b in zip(jax.tree_util.tree_leaves(v_frozen),
                     jax.tree_util.tree_leaves(st.v)):
@@ -75,7 +75,7 @@ def test_error_feedback_bounded_and_unbiased():
     errs = []
     for _ in range(50):
         g = {"w": jnp.asarray(rng.standard_normal(128).astype(np.float32))}
-        params, st = onebit_adam_update(g, st, params, lr=0.0, freeze_step=0)
+        params, st, _ = onebit_adam_update(g, st, params, lr=0.0, freeze_step=0)
         errs.append(float(jnp.linalg.norm(st.worker_error["w"])))
     # bounded: last-10 average no bigger than ~2x the first-10 average
     assert np.mean(errs[-10:]) < 2.0 * np.mean(errs[:10]) + 1e-3
@@ -174,3 +174,86 @@ def test_engine_onebit_checkpoint_preserves_per_rank_error(tmp_path):
     for a, b in zip(leaves, jax.tree_util.tree_leaves(werr_after)):
         np.testing.assert_array_equal(a, b)
     eng2.train_batch(random_batch(32, seed=99))
+
+
+def test_compress_per_chunk_scale():
+    """The compression scale is per worker-chunk (reference splits the flat
+    tensor into world_size chunks, each with its own L1 scale —
+    onebit_adam.py:141-168), not one scale per tensor."""
+    from deepspeed_tpu.ops.onebit import _compress
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.standard_normal((7, 5)).astype(np.float32))
+    err = jnp.zeros_like(x)
+    t, new_err = _compress(x, err, chunks=4)
+    flat = np.asarray(x).reshape(-1)
+    rows = np.pad(flat, (0, 1)).reshape(4, 9)   # 35 -> pad 1 -> 4 chunks of 9
+    got = np.abs(np.asarray(t).reshape(-1))
+    np.testing.assert_allclose(got[:9], np.abs(rows[0]).mean(), rtol=1e-6)
+    np.testing.assert_allclose(got[27:], np.abs(rows[3]).sum() / 8, rtol=1e-6)
+    # error feedback identity: x + 0 = transmitted + new_error
+    np.testing.assert_allclose(np.asarray(x),
+                               np.asarray(t) + np.asarray(new_err), atol=1e-6)
+    # chunks=1 keeps the single-scale behavior
+    t1, _ = _compress(x, err, chunks=1)
+    np.testing.assert_allclose(np.abs(np.asarray(t1)),
+                               np.abs(flat).mean(), rtol=1e-6)
+
+
+def test_onebit_overflow_skips_and_preserves_error_feedback():
+    """Non-finite grads skip the step in BOTH phases: params, m, v, error
+    buffers and the Adam step count are untouched (reference keeps the fp16
+    overflow machinery through compression, onebit_adam.py:104-228)."""
+    params = {"w": jnp.ones((64,), jnp.float32)}
+    st = init_state(params)
+    rng = np.random.default_rng(7)
+    mk = lambda: {"w": jnp.asarray(rng.standard_normal(64).astype(np.float32))}
+    for _ in range(4):     # into the compressed phase, errors populated
+        params, st, aux = onebit_adam_update(mk(), st, params, lr=1e-2,
+                                             freeze_step=2)
+    assert not bool(aux["overflow"]) and np.isfinite(float(aux["grad_norm"]))
+    snap = jax.tree_util.tree_map(np.asarray, (params, st))
+    bad = {"w": jnp.full((64,), jnp.nan, jnp.float32)}
+    params2, st2, aux2 = onebit_adam_update(bad, st, params, lr=1e-2,
+                                            freeze_step=2)
+    assert bool(aux2["overflow"])
+    for a, b in zip(jax.tree_util.tree_leaves(snap),
+                    jax.tree_util.tree_leaves(
+                        jax.tree_util.tree_map(np.asarray, (params2, st2)))):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_engine_onebit_fp16_dynamic_scale_recovers():
+    """fp16 + OnebitAdam: dynamic loss scale halves on an injected overflow,
+    the step is skipped, and training resumes."""
+    mesh = build_mesh()
+    cfg = {
+        "train_batch_size": 32, "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "OneBitAdam",
+                      "params": {"lr": 5e-3, "freeze_step": 2}},
+        "fp16": {"enabled": True, "initial_scale_power": 4,
+                 "hysteresis": 1},
+        "steps_per_print": 10 ** 9,
+    }
+    eng = DeepSpeedEngine(model=simple_loss_fn, model_params=_params(),
+                          config=cfg, mesh=mesh)
+    losses = []
+    for i in range(8):
+        losses.append(float(jax.device_get(
+            eng.train_batch(random_batch(32, seed=i)))))
+    assert all(np.isfinite(losses))
+    scale0 = eng.loss_scale()
+    skipped0 = int(jax.device_get(eng.state.skipped_steps))
+    # Inject a real overflow: NaN inputs make the grads non-finite.
+    bad = jax.tree_util.tree_map(
+        lambda x: (x * np.nan if x.dtype.kind == "f" else x),
+        random_batch(32, seed=0))
+    eng.train_batch(bad)
+    assert eng.loss_scale() == scale0 / 2, \
+        f"hysteresis=1 overflow must halve the scale ({scale0} -> " \
+        f"{eng.loss_scale()})"
+    assert int(jax.device_get(eng.state.skipped_steps)) == skipped0 + 1
+    after = [float(jax.device_get(eng.train_batch(random_batch(32, seed=i))))
+             for i in range(8, 12)]
+    assert all(np.isfinite(after))
+    assert after[-1] <= losses[0]
