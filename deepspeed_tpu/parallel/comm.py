@@ -150,3 +150,29 @@ def sparse_all_reduce(dense_grads_by_rank):
     total = all_gather_csr(shards)
     shipped = sum(s.sparse_size() for s in shards)
     return total.to_dense(), shipped, total.dense_size
+
+
+def csr_exchange_hosts(csr):
+    """Cross-process CSR allgather: size gather → pad every shard to the
+    max row count → allgather indices+values → trim → coalesce. Mirrors the
+    reference's ``csr_all_gather`` padding protocol (engine.py:1234-1253)
+    over the jax.distributed host channel; this is the DCN wire format
+    whose volume is what sparse gradients exist to save.
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+    from ..runtime.csr_tensor import CSRTensor, all_gather_csr
+    n = np.asarray([csr.row_indices.shape[0]], np.int32)
+    sizes = np.asarray(multihost_utils.process_allgather(n)).reshape(-1)
+    mx = max(1, int(sizes.max()))
+    pad = mx - int(n[0])
+    idx = np.pad(csr.row_indices, (0, pad))
+    vals = np.pad(np.asarray(csr.values, np.float32), ((0, pad), (0, 0)))
+    all_idx = np.asarray(multihost_utils.process_allgather(idx))
+    all_vals = np.asarray(multihost_utils.process_allgather(vals))
+    shards = [CSRTensor(all_idx[p][:sizes[p]], all_vals[p][:sizes[p]],
+                        csr.dense_shape)
+              for p in range(sizes.shape[0]) if sizes[p] > 0]
+    if not shards:
+        return csr
+    return all_gather_csr(shards)

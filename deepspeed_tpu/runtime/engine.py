@@ -134,7 +134,7 @@ class DeepSpeedEngine:
                  dist_init_required=None, collate_fn=None,
                  config: Union[str, Dict[str, Any], None] = None, rng=None,
                  mesh: Optional[Mesh] = None, dont_change_device: bool = False,
-                 param_shardings=None):
+                 param_shardings=None, sparse_grad_filter=None):
         if dist_init_required is None or dist_init_required:
             comm.init_distributed()
 
@@ -338,6 +338,17 @@ class DeepSpeedEngine:
         self._train_step_fn = None
         self._eval_step_fn = None
         self._apply_grads_fn = None
+        self._sparse_grad_fn = None
+        self._sparse_apply_fn = None
+
+        # Sparse (CSR) embedding gradients (reference engine.py:179-186
+        # detects torch.nn.Embedding modules; :1197-1253 routes their grads
+        # through a values+indices allgather instead of dense allreduce).
+        self._sparse_mask = None
+        self._sparse_names: List[str] = []
+        self.sparse_comm_stats: Dict[str, int] = {}
+        if self.config.sparse_gradients_enabled:
+            self._init_sparse_gradients(sparse_grad_filter)
         self._grad_step_fn = None
         self._offload_grad_fn = None
 
@@ -623,6 +634,189 @@ class DeepSpeedEngine:
         self.skipped_steps = off.skipped_steps
         metrics["loss"] = loss
         return metrics
+
+    # ------------------------------------------------------------------ #
+    # Sparse (CSR) embedding gradients
+    # ------------------------------------------------------------------ #
+    def _init_sparse_gradients(self, sparse_grad_filter) -> None:
+        """Mark the param leaves whose grads travel the CSR path.
+
+        The reference keys on ``torch.nn.Embedding`` instances
+        (engine.py:179-186); the functional analogue is a predicate over
+        param paths — by default 2-D leaves whose path contains "embed" or
+        "wte" (lookup tables). ``sparse_grad_filter(path_str, leaf) -> bool``
+        overrides the default.
+        """
+        if self.zero_optimization_stage() >= 1:
+            raise ValueError(
+                "sparse_gradients requires ZeRO stage 0: under ZeRO grads "
+                "are born dp-sharded and the dense reduce-scatter already "
+                "ships 1/dp of every tensor")
+        if self._onebit:
+            raise ValueError(
+                "sparse_gradients does not compose with OnebitAdam (the "
+                "compressed momentum exchange replaces the grad allreduce)")
+        if self.config.fp16_enabled:
+            raise NotImplementedError(
+                "sparse_gradients + fp16: the CSR exchange runs host-side, "
+                "outside the jitted loss-scale machinery; use bf16")
+
+        def default(path, leaf):
+            p = path.lower()
+            return getattr(leaf, "ndim", 0) == 2 and \
+                ("embed" in p or "wte" in p)
+
+        filt = sparse_grad_filter or default
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            self.state.params)
+        mask_leaves, names = [], []
+        for path, leaf in flat:
+            path_str = jax.tree_util.keystr(path)
+            is_sparse = bool(filt(path_str, leaf))
+            mask_leaves.append(is_sparse)
+            if is_sparse:
+                names.append(path_str)
+        if not names:
+            logger.warning("sparse_gradients enabled but no param leaf "
+                           "matched the embedding predicate — dense "
+                           "allreduce will be used for everything")
+            return
+        self._sparse_mask = jax.tree_util.tree_unflatten(treedef, mask_leaves)
+        self._sparse_names = names
+        for n in names:
+            log_dist(f"Will convert {n} to sparse (csr) tensor during "
+                     "training", ranks=[0])
+
+    def _build_sparse_grad_fn(self):
+        """Per-rank grads under shard_map over dp: dense leaves are
+        psum-averaged in-graph (ICI, where dense is the fast path); sparse
+        embedding leaves come back per-rank [dp, V, H] for the host CSR
+        exchange, whose wire volume is nnz_rows/vocab of dense (reference
+        engine.py:1197-1253)."""
+        shard_map = jax.shard_map
+        gas = self._scan_microbatches()
+        loss_fn = self.loss_fn
+        compute_dtype = self.compute_dtype
+        dp, mesh = self.dp_size, self.mesh
+        mask = self._sparse_mask
+        pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
+
+        def per_rank(params, step, micro_batches, keys):
+            rank = lax.axis_index(DP_AXIS)
+            keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
+            theta = pld.theta_at(step.astype(jnp.float32)) \
+                if accepts_pld else None
+
+            def mean_loss_fn(p):
+                def one_micro(loss_acc, xs):
+                    mb, key = xs
+                    cparams = _cast_floats(p, compute_dtype)
+                    out = loss_fn(cparams, mb, key, pld_theta=theta) \
+                        if accepts_pld else loss_fn(cparams, mb, key)
+                    loss = out[0] if isinstance(out, tuple) else out
+                    return loss_acc + loss.astype(jnp.float32) / gas, None
+
+                total, _ = lax.scan(one_micro, jnp.asarray(0.0, jnp.float32),
+                                    (micro_batches, keys))
+                return total
+
+            loss_val, grads = jax.value_and_grad(mean_loss_fn)(params)
+            grads = jax.tree_util.tree_map(
+                lambda g, m: g[None] if m else lax.psum(g, DP_AXIS) / dp,
+                grads, mask)
+            return grads, lax.psum(loss_val, DP_AXIS) / dp
+
+        def grad_step(params, step, micro_batches, rng):
+            rng = jax.random.fold_in(rng, step)
+            keys = jax.random.split(rng, gas)
+            batch_specs = jax.tree_util.tree_map(
+                lambda _: P(None, DP_AXIS), micro_batches)
+            grad_specs = jax.tree_util.tree_map(
+                lambda m: P(DP_AXIS) if m else P(), mask)
+            fn = shard_map(per_rank, mesh=mesh,
+                           in_specs=(P(), P(), batch_specs, P()),
+                           out_specs=(grad_specs, P()),
+                           check_vma=False)
+            return fn(params, step, micro_batches, keys)
+
+        return jax.jit(grad_step)
+
+    def _build_sparse_apply_fn(self):
+        """Optimizer apply on the CSR-combined (now dense, replicated)
+        grads: global-norm clip + tx update, same semantics as the main
+        path's step."""
+        tx = self.tx
+        clip = self.gradient_clipping()
+        schedule_fn = self._schedule_fn
+
+        def apply_step(state, grads):
+            grad_norm = global_norm(grads)
+            if clip and clip > 0:
+                coeff = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * coeff, grads)
+            updates, new_opt = tx.update(grads, state.opt_state, state.params)
+            import optax
+            new_params = optax.apply_updates(state.params, updates)
+            new_state = state.replace(step=state.step + 1,
+                                      params=new_params, opt_state=new_opt)
+            return new_state, grad_norm, schedule_fn(state.step)
+
+        return jax.jit(apply_step, donate_argnums=(0,))
+
+    def _csr_exchange(self, grads):
+        """Replace each sparse leaf's stacked per-rank grads [dp, V, H]
+        with the CSR-allreduced dense mean. Mirrors the reference's
+        csr_allreduce (engine.py:1212-1253): extract nonzero rows, gather
+        values+indices across ranks (padded allgather across hosts),
+        coalesce, densify. Returns (grads, shipped_elems, dense_elems)."""
+        from .csr_tensor import CSRTensor, all_gather_csr
+        procs = jax.process_count()
+        repl = NamedSharding(self.mesh, P())
+        shipped = [0]
+        dense_n = [0]
+
+        def combine(g, m):
+            if not m:
+                return g
+            if procs == 1:
+                ranks = list(np.asarray(jax.device_get(g)))
+            else:
+                # Each process holds its local dp ranks; dedupe replicas
+                # from other mesh axes by dp slot.
+                seen = {}
+                for sh in g.addressable_shards:
+                    slot = sh.index[0].start or 0
+                    if slot not in seen:
+                        seen[slot] = np.asarray(sh.data)[0]
+                ranks = [seen[k] for k in sorted(seen)]
+            csr_shards = [CSRTensor.from_dense(r) for r in ranks]
+            shipped[0] += sum(s.sparse_size() for s in csr_shards)
+            local = all_gather_csr(csr_shards)
+            if procs > 1:
+                local = comm.csr_exchange_hosts(local)
+            dense = (local.to_dense() / self.dp_size).astype(np.float32)
+            dense_n[0] += local.dense_size
+            if procs > 1:
+                return jax.make_array_from_process_local_data(repl, dense)
+            return jax.device_put(dense, repl)
+
+        new_grads = jax.tree_util.tree_map(combine, grads, self._sparse_mask)
+        return new_grads, shipped[0], dense_n[0]
+
+    def _train_batch_sparse(self, micro_batches):
+        if self._sparse_grad_fn is None:
+            self._sparse_grad_fn = self._build_sparse_grad_fn()
+            self._sparse_apply_fn = self._build_sparse_apply_fn()
+        grads, loss = self._sparse_grad_fn(
+            self.state.params, jnp.asarray(self.global_steps, jnp.int32),
+            micro_batches, self._base_rng)
+        grads, shipped, dense_n = self._csr_exchange(grads)
+        self.sparse_comm_stats = {"sparse_elements": int(shipped),
+                                  "dense_elements": int(dense_n)}
+        self.state, grad_norm, lr = self._sparse_apply_fn(self.state, grads)
+        return {"loss": loss, "grad_norm": grad_norm, "lr": lr,
+                "loss_scale": jnp.asarray(1.0),
+                "overflow": jnp.asarray(False)}
 
     # ------------------------------------------------------------------ #
     # The jitted train step
@@ -953,7 +1147,9 @@ class DeepSpeedEngine:
         ``batch``: pytree with leading dim ``gas * micro * dp_local``; or pull
         ``gas`` micro-batches from ``data_iter`` / the engine's dataloader.
         """
-        if self._train_step_fn is None and self._offload is None:
+        sparse_path = self._sparse_mask is not None and self.dp_size > 1
+        if self._train_step_fn is None and self._offload is None \
+                and not sparse_path:
             self._train_step_fn = self._build_train_step()
 
         if batch is None:
@@ -999,6 +1195,8 @@ class DeepSpeedEngine:
         self.tput_timer.start()
         if self._offload is not None:
             metrics = self._train_batch_offload(micro_batches)
+        elif sparse_path:
+            metrics = self._train_batch_sparse(micro_batches)
         else:
             self.state, metrics = self._train_step_fn(
                 self.state, micro_batches, self._base_rng)

@@ -1,0 +1,149 @@
+"""Sparse (CSR) embedding gradients, wired end-to-end through the engine.
+
+Reference: engine.py:179-186 (detect torch.nn.Embedding modules when
+``sparse_gradients`` is set) and :1197-1253 (route their grads through a
+values+indices allgather + densify instead of the dense allreduce).
+
+Here: ``sparse_gradients: true`` marks embedding-shaped param leaves (path
+contains "embed"/"wte"), the engine computes per-rank grads under shard_map,
+ships the embedding grads row-sparse via the host CSR exchange, and the
+optimizer applies the combined (mean) grads — parity with the dense path.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import build_mesh
+
+VOCAB, HID = 512, 8
+
+
+def model_params(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "embedding": jnp.asarray(
+            rng.standard_normal((VOCAB, HID)).astype(np.float32) * 0.1),
+        "out_w": jnp.asarray(
+            rng.standard_normal((HID, 1)).astype(np.float32) * 0.1),
+    }
+
+
+def loss_fn(params, batch, rng):
+    emb = params["embedding"][batch["ids"]]        # [B, L, H]
+    pooled = jnp.mean(emb, axis=1)                 # [B, H]
+    pred = pooled @ params["out_w"]                # [B, 1]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+_TRUE = np.random.default_rng(1234).standard_normal(VOCAB).astype(np.float32)
+
+
+def make_batch(i, n=32, rows=8):
+    """Each batch touches only ``rows`` distinct vocab rows — the regime
+    sparse gradients exist for. Targets are a learnable function of the
+    touched rows."""
+    r = np.random.default_rng(i)
+    ids = r.integers(0, rows, size=(n, 4))
+    y = _TRUE[ids].mean(axis=1, keepdims=True)
+    return {"ids": jnp.asarray(ids), "y": jnp.asarray(y)}
+
+
+def _cfg(sparse, **over):
+    cfg = {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "sparse_gradients": sparse,
+        "steps_per_print": 10 ** 9,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def test_engine_detects_embedding_leaves():
+    eng = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                          config=_cfg(True), mesh=build_mesh())
+    assert eng._sparse_names and "embedding" in eng._sparse_names[0]
+    # 1-D / non-embedding leaves are not marked
+    flat = jax.tree_util.tree_leaves(eng._sparse_mask)
+    assert sum(flat) == 1
+
+
+def test_sparse_parity_with_dense_allreduce():
+    """N steps with the CSR path == N steps with dense allreduce."""
+    mesh = build_mesh()
+    eng_s = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                            config=_cfg(True), mesh=mesh)
+    eng_d = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                            config=_cfg(False), mesh=mesh)
+    for i in range(5):
+        b = make_batch(i)
+        ls = float(jax.device_get(eng_s.train_batch(b)))
+        ld = float(jax.device_get(eng_d.train_batch(b)))
+        np.testing.assert_allclose(ls, ld, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        jax.device_get(eng_s.state.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(eng_d.state.params))):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_sparse_comm_volume_savings():
+    """The shipped CSR payload is a fraction of the dense tensor when the
+    batch touches few rows (reference's raison d'être for the path)."""
+    eng = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                          config=_cfg(True), mesh=build_mesh())
+    eng.train_batch(make_batch(0, rows=8))
+    st = eng.sparse_comm_stats
+    assert st["sparse_elements"] > 0
+    # 8 touched rows of 512 -> ~1/64 of the elements (plus index overhead)
+    assert st["sparse_elements"] < 0.25 * st["dense_elements"]
+
+
+def test_sparse_grad_norm_and_clip_reported():
+    eng = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                          config=_cfg(True, gradient_clipping=1.0),
+                          mesh=build_mesh())
+    eng.train_batch(make_batch(0))
+    # metrics come back through _maybe_log's contract: loss finite
+    loss = float(jax.device_get(eng.train_batch(make_batch(1))))
+    assert np.isfinite(loss)
+
+
+def test_sparse_gradients_gates():
+    mesh = build_mesh()
+    with pytest.raises(ValueError):
+        DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                        config=_cfg(True, zero_optimization={"stage": 1}),
+                        mesh=mesh)
+    with pytest.raises(NotImplementedError):
+        DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                        config=_cfg(True, fp16={"enabled": True}),
+                        mesh=mesh)
+    with pytest.raises(ValueError):
+        DeepSpeedEngine(
+            model=loss_fn, model_params=model_params(),
+            config=_cfg(True, optimizer={"type": "OneBitAdam",
+                                         "params": {"lr": 1e-3}}),
+            mesh=mesh)
+
+
+def test_sparse_custom_filter():
+    eng = DeepSpeedEngine(
+        model=loss_fn, model_params=model_params(), config=_cfg(True),
+        mesh=build_mesh(),
+        sparse_grad_filter=lambda path, leaf: "out_w" in path)
+    assert eng._sparse_names == ["['out_w']"] or "out_w" in eng._sparse_names[0]
+    loss = float(jax.device_get(eng.train_batch(make_batch(0))))
+    assert np.isfinite(loss)
+
+
+def test_sparse_trains_to_convergence():
+    eng = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                          config=_cfg(True), mesh=build_mesh())
+    losses = [float(jax.device_get(eng.train_batch(make_batch(i))))
+              for i in range(30)]
+    assert losses[-1] < 0.5 * losses[0]
