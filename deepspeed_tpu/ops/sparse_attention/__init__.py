@@ -11,9 +11,14 @@ from .sparsity_config import (SparsityConfig, DenseSparsityConfig,
                               FixedSparsityConfig, VariableSparsityConfig,
                               BigBirdSparsityConfig, BSLongformerSparsityConfig)
 from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .config_factory import (normalize_sparse_attention,
+                             sparsity_config_from_dict)
+from .sparse_attention_utils import SparseAttentionUtils
 
 __all__ = [
     "SparsityConfig", "DenseSparsityConfig", "FixedSparsityConfig",
     "VariableSparsityConfig", "BigBirdSparsityConfig",
     "BSLongformerSparsityConfig", "SparseSelfAttention", "sparse_attention",
+    "normalize_sparse_attention", "sparsity_config_from_dict",
+    "SparseAttentionUtils",
 ]

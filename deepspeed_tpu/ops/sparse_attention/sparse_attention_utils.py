@@ -1,0 +1,194 @@
+"""Utilities for adapting pretrained transformers to sparse self-attention.
+
+Parity with reference ``ops/sparse_attention/sparse_attention_utils.py:13-210``
+(SparseAttentionUtils: extend_position_embedding, tokenizer max-length
+update, self-attention swap for HF BERT/RoBERTa, pad/unpad to block size).
+
+TPU-native shape: HF Flax models are immutable pytrees, so "replacing the
+attention module" becomes building a functional encoder — the HF encoder
+params are re-stacked through ``module_inject`` and run with a
+sparse ``attention_fn`` (layout-gated Pallas flash kernel) instead of the
+dense one. Position-embedding extension and sequence padding are pure
+array ops on the param/input pytrees.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparse_self_attention import SparseSelfAttention, sparse_attention
+from .sparsity_config import FixedSparsityConfig, SparsityConfig
+
+
+def _find_embeddings(params: Dict[str, Any]) -> Dict[str, Any]:
+    """HF Flax BERT/RoBERTa param trees keep tables under ``embeddings``."""
+    if "embeddings" not in params:
+        raise ValueError(
+            'Please extend "extend_position_embedding" to support your '
+            'model type. It currently only supports HF Flax "bert" & '
+            '"roberta" param trees (an "embeddings" collection).')
+    return params["embeddings"]
+
+
+class SparseAttentionUtils:
+    """Reference-parity utility surface (sparse_attention_utils.py:13)."""
+
+    @staticmethod
+    def extend_position_embedding(params: Dict[str, Any], max_position: int,
+                                  model_type: str = "bert"
+                                  ) -> Dict[str, Any]:
+        """Tile the position-embedding table of a pretrained checkpoint up
+        to ``max_position`` (reference :19-66). RoBERTa reserves positions
+        0 & 1, so its table is ``max_position + 2`` rows and the tiling
+        starts at row 2. Returns a NEW param tree (input is not mutated)."""
+        emb = _find_embeddings(params)
+        table = np.asarray(emb["position_embeddings"]["embedding"])
+        if model_type == "bert":
+            orig = table.shape[0]
+            if max_position <= orig:
+                raise ValueError(f"new max position {max_position} must "
+                                 f"exceed the original {orig}")
+            reps = max(1, max_position // orig)
+            new_table = np.tile(table, (reps, 1))
+        elif model_type == "roberta":
+            orig = table.shape[0] - 2
+            if max_position <= orig:
+                raise ValueError(f"new max position {max_position} must "
+                                 f"exceed the original {orig}")
+            reps = max(1, max_position // orig)
+            new_table = np.empty((reps * orig + 2, table.shape[1]),
+                                 table.dtype)
+            new_table[:2] = table[:2]
+            for i in range(reps):
+                new_table[2 + i * orig: 2 + (i + 1) * orig] = table[2:]
+        else:
+            raise ValueError(
+                'Please extend "extend_position_embedding" to support '
+                f'model type "{model_type}" (bert / roberta supported)')
+
+        out = jax.tree_util.tree_map(lambda x: x, params)  # shallow clone
+        out["embeddings"] = dict(emb)
+        out["embeddings"]["position_embeddings"] = dict(
+            emb["position_embeddings"])
+        out["embeddings"]["position_embeddings"]["embedding"] = \
+            jnp.asarray(new_table)
+        return out
+
+    @staticmethod
+    def update_tokenizer_model_max_length(tokenizer, max_position: int):
+        """Reference :68-83 — framework-agnostic."""
+        tokenizer.model_max_length = max_position
+        tokenizer.init_kwargs["model_max_length"] = max_position
+        return tokenizer
+
+    @staticmethod
+    def replace_model_self_attention_with_sparse_self_attention(
+            hf_config, hf_params: Dict[str, Any],
+            sparsity_config: Optional[SparsityConfig] = None,
+            max_position: Optional[int] = None):
+        """The functional form of the reference's module swap (:85-148).
+
+        Returns ``(encoder_fn, stacked_params, cfg)``:
+        ``encoder_fn(stacked_params, hidden_states, key_padding_mask=None,
+        rng=None, deterministic=True)`` runs the HF encoder weights through
+        the fused TPU blocks with block-sparse attention.
+        ``hidden_states``' sequence length must be a multiple of the
+        sparsity block size — use ``pad_to_block_size``.
+        """
+        from ...models.transformer import apply_blocks
+        from ...module_inject.replace import (bert_config_from_hf,
+                                              extract_bert_encoder)
+        cfg = bert_config_from_hf(hf_config)
+        if sparsity_config is None:
+            sparsity_config = FixedSparsityConfig(num_heads=cfg.num_heads)
+        if sparsity_config.num_heads != cfg.num_heads:
+            raise ValueError(
+                f"sparsity_config.num_heads={sparsity_config.num_heads} "
+                f"does not match the model's {cfg.num_heads}")
+        if max_position is not None:
+            import dataclasses
+            cfg = dataclasses.replace(cfg, max_seq_length=max_position)
+        stacked = extract_bert_encoder(hf_params)
+        ssa = SparseSelfAttention(sparsity_config)
+
+        def attention_fn(q, k, v, mask=None, causal=False, attn_dropout=0.0,
+                         rng=None, deterministic=True):
+            layout = ssa.get_layout(q.shape[1])
+            return sparse_attention(q, k, v, layout, causal=causal,
+                                    mask=mask, attn_dropout=attn_dropout,
+                                    rng=rng, deterministic=deterministic)
+
+        def encoder_fn(params, hidden_states, key_padding_mask=None,
+                       rng=None, deterministic=True):
+            mask = None
+            if key_padding_mask is not None:
+                pad = 1.0 - key_padding_mask.astype(jnp.float32)
+                mask = pad[:, None, None, :] * -1e30
+            return apply_blocks(params, hidden_states, cfg, mask=mask,
+                                rng=rng, deterministic=deterministic,
+                                attention_fn=attention_fn)
+
+        return encoder_fn, stacked, cfg
+
+    @staticmethod
+    def pad_to_block_size(block_size: int,
+                          input_ids: Optional[jnp.ndarray] = None,
+                          attention_mask: Optional[jnp.ndarray] = None,
+                          token_type_ids: Optional[jnp.ndarray] = None,
+                          position_ids: Optional[jnp.ndarray] = None,
+                          inputs_embeds: Optional[jnp.ndarray] = None,
+                          pad_token_id: int = 0,
+                          model_embeddings=None) -> Tuple[int, ...]:
+        """Pad the sequence dim to a multiple of the sparsity block size
+        (reference :150-195). Returns ``(pad_len, input_ids,
+        attention_mask, token_type_ids, position_ids, inputs_embeds)`` —
+        arrays that were given come back padded, others come back None.
+
+        ``model_embeddings``: callable mapping padded token ids ->
+        embeddings; used to fill the pad region of ``inputs_embeds`` like
+        the reference does with the model's embedding module.
+        """
+        if input_ids is not None:
+            seq_len = input_ids.shape[1]
+        elif inputs_embeds is not None:
+            seq_len = inputs_embeds.shape[1]
+        else:
+            raise ValueError("need input_ids or inputs_embeds")
+        pad_len = (block_size - seq_len % block_size) % block_size
+        if pad_len > 0:
+            def pad_tokens(x, value):
+                return jnp.pad(x, ((0, 0), (0, pad_len)),
+                               constant_values=value)
+            if inputs_embeds is not None:
+                bsz = inputs_embeds.shape[0]
+                pad_ids = jnp.full((bsz, pad_len), pad_token_id, jnp.int32)
+                if model_embeddings is None:
+                    pad_emb = jnp.zeros(
+                        (bsz, pad_len, inputs_embeds.shape[-1]),
+                        inputs_embeds.dtype)
+                else:
+                    pad_emb = model_embeddings(pad_ids)
+                inputs_embeds = jnp.concatenate([inputs_embeds, pad_emb],
+                                                axis=1)
+            if input_ids is not None:
+                input_ids = pad_tokens(input_ids, pad_token_id)
+            if position_ids is not None:
+                position_ids = pad_tokens(position_ids, pad_token_id)
+            if attention_mask is not None:
+                attention_mask = pad_tokens(attention_mask, 0)
+            if token_type_ids is not None:
+                token_type_ids = pad_tokens(token_type_ids, 0)
+        return (pad_len, input_ids, attention_mask, token_type_ids,
+                position_ids, inputs_embeds)
+
+    @staticmethod
+    def unpad_sequence_output(pad_len: int,
+                              sequence_output: jnp.ndarray) -> jnp.ndarray:
+        """Drop the pad region added by pad_to_block_size (reference
+        :197-210)."""
+        if pad_len > 0:
+            sequence_output = sequence_output[:, :-pad_len]
+        return sequence_output

@@ -14,6 +14,7 @@ from typing import Dict, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax import lax
 
 from ..flash_attention import flash_attention, _layout_to_mask
 from .sparsity_config import FixedSparsityConfig, SparsityConfig
@@ -22,6 +23,7 @@ from .sparsity_config import FixedSparsityConfig, SparsityConfig
 def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                      layout: jnp.ndarray, causal: bool = False,
                      mask: Optional[jnp.ndarray] = None,
+                     rpe: Optional[jnp.ndarray] = None,
                      attn_dropout: float = 0.0, rng=None,
                      deterministic: bool = True) -> jnp.ndarray:
     """q,k,v: [B, S, nH, dH]; layout: [nH, S//block, S//block] int.
@@ -29,7 +31,17 @@ def sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     The layout must give every query row at least one visible block (all
     five shipped SparsityConfigs do — local windows include the diagonal),
     otherwise that row's softmax denominator is empty.
+
+    ``rpe``: additive relative-position bias, [nH, S, S] or [S, S]
+    (broadcast over batch), added to the scores pre-softmax like the
+    reference's sparse softmax RPE input (softmax.py:259-291). Treated as
+    a constant (no gradient flows to it), matching the reference kernel.
     """
+    if rpe is not None:
+        if rpe.ndim == 2:
+            rpe = rpe[None]
+        bias = lax.stop_gradient(rpe)[None]        # [1, nH, S, S]
+        mask = bias if mask is None else mask + bias
     return flash_attention(q, k, v, mask=mask, causal=causal,
                            attn_dropout=attn_dropout, rng=rng,
                            deterministic=deterministic, layout=layout)
@@ -58,6 +70,16 @@ class SparseSelfAttention:
         self.attn_mask_mode = attn_mask_mode
         self._layout_cache: Dict[int, np.ndarray] = {}
 
+    @classmethod
+    def from_config(cls, sparse_attention_section: Dict, num_heads: int,
+                    **kwargs) -> "SparseSelfAttention":
+        """Build from a ds_config ``sparse_attention`` section (the dict
+        DeepSpeedConfig.sparse_attention stores) — the consumption side of
+        reference config.py:192-362."""
+        from .config_factory import sparsity_config_from_dict
+        return cls(sparsity_config_from_dict(sparse_attention_section,
+                                             num_heads), **kwargs)
+
     def get_layout(self, seq_len: int) -> np.ndarray:
         if seq_len not in self._layout_cache:
             self._layout_cache[seq_len] = \
@@ -66,6 +88,7 @@ class SparseSelfAttention:
 
     def __call__(self, query: jnp.ndarray, key: jnp.ndarray,
                  value: jnp.ndarray,
+                 rpe: Optional[jnp.ndarray] = None,
                  key_padding_mask: Optional[jnp.ndarray] = None,
                  attn_mask: Optional[jnp.ndarray] = None,
                  rng=None, deterministic: bool = True) -> jnp.ndarray:
@@ -90,5 +113,5 @@ class SparseSelfAttention:
                 attn_mask = jnp.where(attn_mask != 0, 0.0, -1e30)
             mask = attn_mask if mask is None else mask + attn_mask
         return sparse_attention(query, key, value, layout,
-                                causal=False, mask=mask, rng=rng,
+                                causal=False, mask=mask, rpe=rpe, rng=rng,
                                 deterministic=deterministic)

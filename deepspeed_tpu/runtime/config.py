@@ -238,7 +238,14 @@ class DeepSpeedConfig:
                                         C.WALL_CLOCK_BREAKDOWN_DEFAULT)
         self.memory_breakdown = get(d, C.MEMORY_BREAKDOWN, C.MEMORY_BREAKDOWN_DEFAULT)
 
-        self.sparse_attention = d.get(C.SPARSE_ATTENTION)
+        # Normalized like the reference's get_sparse_attention
+        # (config.py:192-362): mode-specific defaults filled, unknown modes
+        # rejected at config time. sparsity_config_from_dict() turns this
+        # into the layout object SparseSelfAttention consumes.
+        from ..ops.sparse_attention.config_factory import \
+            normalize_sparse_attention
+        self.sparse_attention = normalize_sparse_attention(
+            d.get(C.SPARSE_ATTENTION))
 
         ckpt = d.get(C.CHECKPOINT, {})
         self.checkpoint_tag_validation_mode = get(

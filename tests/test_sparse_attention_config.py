@@ -1,0 +1,192 @@
+"""The ds_config ``sparse_attention`` section, live end-to-end.
+
+Reference: runtime/config.py:192-362 (mode-string → normalized section),
+ops/sparse_attention/sparse_attention_utils.py:13-210 (SparseAttentionUtils)
+and softmax.py:259-291 (RPE input).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseAttentionUtils, SparseSelfAttention,
+    VariableSparsityConfig, normalize_sparse_attention,
+    sparsity_config_from_dict, sparse_attention)
+from deepspeed_tpu.ops.sparse_attention.sparse_self_attention import \
+    sparse_attention_reference
+
+
+BASE = {"train_batch_size": 8, "train_micro_batch_size_per_gpu": 1,
+        "gradient_accumulation_steps": 1}
+
+
+def _cfg(section):
+    return DeepSpeedConfig({**BASE, "sparse_attention": section},
+                           world_size=8)
+
+
+def test_config_normalizes_defaults_per_mode():
+    cfg = _cfg({"mode": "fixed", "block": 32})
+    sa = cfg.sparse_attention
+    assert sa["mode"] == "fixed" and sa["block"] == 32
+    assert sa["num_local_blocks"] == 4 and sa["num_global_blocks"] == 1
+    assert sa["attention"] == "bidirectional"
+    cfg = _cfg({"mode": "bigbird"})
+    sa = cfg.sparse_attention
+    assert sa["num_sliding_window_blocks"] == 3 and sa["block"] == 16
+    cfg = _cfg({"mode": "bslongformer"})
+    assert cfg.sparse_attention["global_block_indices"] == [0]
+    cfg = _cfg({"mode": "dense"})
+    assert set(cfg.sparse_attention) == {"mode", "block"}
+    assert DeepSpeedConfig(dict(BASE), world_size=8).sparse_attention is None
+
+
+def test_config_rejects_unknown_mode_and_keys():
+    with pytest.raises(NotImplementedError):
+        _cfg({"mode": "strided"})
+    with pytest.raises(ValueError):
+        _cfg({"mode": "dense", "num_local_blocks": 4})
+
+
+def test_factory_builds_every_mode():
+    cases = [
+        ({"mode": "dense"}, DenseSparsityConfig),
+        ({"mode": "fixed", "num_local_blocks": 8}, FixedSparsityConfig),
+        ({"mode": "variable", "num_random_blocks": 1,
+          "local_window_blocks": [2, 4]}, VariableSparsityConfig),
+        ({"mode": "bigbird", "num_random_blocks": 2}, BigBirdSparsityConfig),
+        ({"mode": "bslongformer", "num_sliding_window_blocks": 5},
+         BSLongformerSparsityConfig),
+    ]
+    for section, cls in cases:
+        sc = sparsity_config_from_dict({**section, "block": 16}, num_heads=4)
+        assert isinstance(sc, cls), section
+        layout = sc.make_layout(256)
+        assert layout.shape == (4, 16, 16)
+        assert layout.sum() > 0
+
+
+def test_sparse_self_attention_from_config_runs():
+    ssa = SparseSelfAttention.from_config(
+        {"mode": "fixed", "block": 16, "num_local_blocks": 2}, num_heads=2)
+    rng = jax.random.PRNGKey(0)
+    q, k, v = [jax.random.normal(jax.random.fold_in(rng, i), (1, 64, 2, 8))
+               for i in range(3)]
+    out = ssa(q, k, v)
+    assert out.shape == (1, 64, 2, 8)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_rpe_bias_matches_dense_reference():
+    """Additive RPE changes the scores exactly like adding it to the dense
+    mask (reference softmax.py RPE semantics)."""
+    rng = jax.random.PRNGKey(1)
+    B, S, nH, dH = 2, 64, 2, 8
+    q, k, v = [jax.random.normal(jax.random.fold_in(rng, i), (B, S, nH, dH))
+               for i in range(3)]
+    sc = FixedSparsityConfig(num_heads=nH, block=16, num_local_blocks=2)
+    layout = sc.make_layout(S)
+    rpe = jax.random.normal(jax.random.fold_in(rng, 9), (nH, S, S)) * 0.5
+    got = sparse_attention(q, k, v, layout, rpe=rpe)
+    from deepspeed_tpu.ops.flash_attention import _layout_to_mask
+    from deepspeed_tpu.models.transformer import dense_attention
+    want = dense_attention(q, k, v, causal=False,
+                           mask=_layout_to_mask(layout, S, rpe[None]))
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_pad_and_unpad_to_block_size():
+    ids = jnp.ones((2, 50), jnp.int32)
+    mask = jnp.ones((2, 50), jnp.int32)
+    tt = jnp.zeros((2, 50), jnp.int32)
+    pad_len, ids2, mask2, tt2, pos2, emb2 = \
+        SparseAttentionUtils.pad_to_block_size(
+            16, input_ids=ids, attention_mask=mask, token_type_ids=tt,
+            pad_token_id=7)
+    assert pad_len == 14 and ids2.shape == (2, 64)
+    assert int(ids2[0, -1]) == 7 and int(mask2[0, -1]) == 0
+    assert pos2 is None and emb2 is None
+    out = jnp.ones((2, 64, 4))
+    assert SparseAttentionUtils.unpad_sequence_output(pad_len, out).shape \
+        == (2, 50, 4)
+    # already-aligned: no-op
+    pad_len, ids3, *_ = SparseAttentionUtils.pad_to_block_size(
+        16, input_ids=jnp.ones((2, 64), jnp.int32))
+    assert pad_len == 0 and ids3.shape == (2, 64)
+
+
+def test_pad_inputs_embeds_via_model_embeddings():
+    emb_table = jnp.arange(10 * 4, dtype=jnp.float32).reshape(10, 4)
+    embeds = emb_table[jnp.ones((2, 30), jnp.int32)]
+    pad_len, _, _, _, _, out = SparseAttentionUtils.pad_to_block_size(
+        16, inputs_embeds=embeds, pad_token_id=3,
+        model_embeddings=lambda ids: emb_table[ids])
+    assert pad_len == 2 and out.shape == (2, 32, 4)
+    np.testing.assert_allclose(np.asarray(out[0, -1]),
+                               np.asarray(emb_table[3]))
+
+
+@pytest.fixture(scope="module")
+def tiny_bert():
+    transformers = pytest.importorskip("transformers")
+    from transformers import BertConfig, FlaxBertModel
+    cfg = BertConfig(vocab_size=128, hidden_size=32, num_hidden_layers=2,
+                     num_attention_heads=2, intermediate_size=64,
+                     max_position_embeddings=64)
+    return cfg, FlaxBertModel(cfg, seed=0)
+
+
+def test_extend_position_embedding(tiny_bert):
+    cfg, model = tiny_bert
+    params = model.params
+    new = SparseAttentionUtils.extend_position_embedding(params, 128)
+    tbl = np.asarray(new["embeddings"]["position_embeddings"]["embedding"])
+    old = np.asarray(
+        params["embeddings"]["position_embeddings"]["embedding"])
+    assert tbl.shape == (128, 32)
+    np.testing.assert_array_equal(tbl[:64], old)
+    np.testing.assert_array_equal(tbl[64:], old)
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.extend_position_embedding(params, 32)
+
+
+def test_replace_bert_attention_with_sparse(tiny_bert):
+    """The functional module swap: HF weights through the fused blocks with
+    block-sparse attention; parity with a dense-masked reference softmax
+    over the same layout."""
+    cfg, model = tiny_bert
+    sc = FixedSparsityConfig(num_heads=2, block=16, num_local_blocks=2)
+    encoder_fn, stacked, tcfg = \
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            cfg, model.params, sparsity_config=sc)
+    x = jax.random.normal(jax.random.PRNGKey(2), (2, 64, 32))
+    out = encoder_fn(stacked, x)
+    assert out.shape == (2, 64, 32)
+
+    # parity: same blocks with a dense attention_fn masked to the layout
+    from deepspeed_tpu.models.transformer import apply_blocks, dense_attention
+    from deepspeed_tpu.ops.flash_attention import _layout_to_mask
+    layout = sc.make_layout(64)
+
+    def dense_masked(q, k, v, mask=None, causal=False, attn_dropout=0.0,
+                     rng=None, deterministic=True):
+        return dense_attention(q, k, v,
+                               mask=_layout_to_mask(layout, 64, mask),
+                               causal=causal)
+
+    want = apply_blocks(stacked, x, tcfg, deterministic=True,
+                        attention_fn=dense_masked)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_replace_rejects_mismatched_heads(tiny_bert):
+    cfg, model = tiny_bert
+    with pytest.raises(ValueError):
+        SparseAttentionUtils.replace_model_self_attention_with_sparse_self_attention(
+            cfg, model.params,
+            sparsity_config=FixedSparsityConfig(num_heads=8, block=16))
