@@ -176,3 +176,16 @@ def csr_exchange_hosts(csr):
     if not shards:
         return csr
     return all_gather_csr(shards)
+
+
+def host_allreduce_sum(x: float) -> float:
+    """Sum a host-side scalar across processes over the jax.distributed
+    channel (the cross-rank reduction the partitioned offload grad norm
+    needs, reference stage2.py:1371-1411)."""
+    import numpy as np
+    if jax.process_count() == 1:
+        return float(x)
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(
+        np.asarray([x], np.float32))
+    return float(np.sum(np.asarray(gathered, np.float64)))

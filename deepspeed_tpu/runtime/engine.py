@@ -204,26 +204,40 @@ class DeepSpeedEngine:
         self._offload: Optional["ZeroOffloadOptimizer"] = None
         if self.config.zero_config.cpu_offload and \
                 self.zero_optimization_stage() >= 1:
-            if jax.process_count() > 1:
-                # Under stage 2 the grads are dp-sharded across processes;
-                # jax.device_get on non-addressable shards raises at runtime
-                # and each host would redundantly run full-tree Adam. The
-                # partitioned host state exists (ZeroOffloadOptimizer
-                # partition_rank/num); the per-process shard gather/assembly
-                # glue does not yet — fail loud at init, not on a pod.
-                raise NotImplementedError(
-                    "zero_optimization.cpu_offload is single-host for now: "
-                    "multi-host offload needs process-local grad-shard "
-                    "gather + partitioned device_put assembly")
             from .zero.offload import ZeroOffloadOptimizer
+            procs = jax.process_count()
+            part_kwargs = {}
+            if procs > 1:
+                # Multi-host: each process owns host partition
+                # process_index/process_count of the masters + moments
+                # (reference stage2.py:775-873 each-rank-updates-its-
+                # partition). The partition axis follows the dp shard rule
+                # (axis_divisor=dp) so it is the same axis the device grads
+                # are sharded on; grads/params are explicitly repartitioned
+                # to process-local shardings around the host step
+                # (_offload_partition_shardings), so no assumption about
+                # device order is needed. The clip norm is allreduced
+                # across processes via the host channel.
+                divisor = self.dp_size if self.dp_size % procs == 0 \
+                    else procs
+                part_kwargs = dict(
+                    partition_rank=jax.process_index(),
+                    partition_num=procs, axis_divisor=divisor,
+                    sumsq_allreduce=comm.host_allreduce_sum)
             self._offload = ZeroOffloadOptimizer(
                 master_params, self.config.optimizer_name,
                 dict(self.config.optimizer_params or {}), self._schedule_fn,
                 self.compute_dtype,
                 gradient_clipping=self.gradient_clipping(),
-                fp16=self.config.fp16_enabled, scaler_cfg=scaler_cfg)
-            # device params = compute-dtype cast; no device moments at all
-            master_params = self._offload.master_tree()
+                fp16=self.config.fp16_enabled, scaler_cfg=scaler_cfg,
+                **part_kwargs)
+            self._offload_down = None   # lazy per-leaf process shardings
+            # device params = compute-dtype cast; no device moments at all.
+            # (Multi-host: master_tree() is partition-local — keep the full
+            # init params for the replicated device state; the per-step
+            # H2D path assembles from partitions thereafter.)
+            if self._offload.partition_num == 1:
+                master_params = self._offload.master_tree()
 
         # State. The optimizer state is *born sharded*: its structure comes
         # from eval_shape (zero bytes), the shardings are computed from that,
@@ -616,6 +630,57 @@ class DeepSpeedEngine:
 
         return jax.jit(grads_step)
 
+    def _offload_partition_shardings(self, procs: Optional[int] = None):
+        """Per-leaf NamedShardings placing each process's host partition on
+        its own devices: the partition axis is sharded over a
+        process-major mesh axis, everything else replicated. Repartitioning
+        grads into these shardings before device_get (and params out of
+        them after the host step) makes every host partition
+        process-addressable via XLA collectives, with no assumption about
+        how the dp shards were laid out."""
+        procs = procs or jax.process_count()
+        off = self._offload
+        devs = np.asarray(jax.devices()).reshape(procs, -1)
+        mesh = Mesh(devs, ("proc", "dev"))
+        leaves, treedef = jax.tree_util.tree_flatten(
+            jax.tree_util.tree_unflatten(off.treedef,
+                                         list(range(len(off.full_shapes)))))
+        specs = []
+        for i in leaves:
+            ax = off._axes[i]
+            if ax is None:
+                specs.append(NamedSharding(mesh, P()))
+            else:
+                spec = [None] * len(off.full_shapes[i])
+                spec[ax] = "proc"
+                specs.append(NamedSharding(mesh, P(*spec)))
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    def _local_offload_grads(self, grads):
+        """Multi-host D2H: repartition grads to the process shardings, then
+        read the (now guaranteed-local) partition of each leaf."""
+        if self._offload_down is None:
+            self._offload_down = self._offload_partition_shardings()
+        grads = jax.jit(lambda t: t,
+                        out_shardings=self._offload_down)(grads)
+        return jax.tree_util.tree_map(
+            lambda g: np.asarray(g.addressable_shards[0].data), grads)
+
+    def _assemble_offload_params(self):
+        """Multi-host H2D: each process contributes its updated partition;
+        XLA all-gathers them into the engine's replicated param sharding."""
+        off = self._offload
+        if self._offload_down is None:
+            self._offload_down = self._offload_partition_shardings()
+        down_leaves = jax.tree_util.tree_leaves(self._offload_down)
+        local = off.local_param_leaves()
+        leaves = [jax.make_array_from_process_local_data(
+                      sh, np.ascontiguousarray(l))
+                  for sh, l in zip(down_leaves, local)]
+        tree = jax.tree_util.tree_unflatten(off.treedef, leaves)
+        return jax.jit(lambda t: t,
+                       out_shardings=self._state_shardings.params)(tree)
+
     def _train_batch_offload(self, micro_batches):
         if self._offload_grad_fn is None:
             self._offload_grad_fn = self._build_offload_grad_fn()
@@ -624,10 +689,14 @@ class DeepSpeedEngine:
             self.state.params, micro_batches, self._base_rng,
             jnp.asarray(self.global_steps, jnp.int32),
             jnp.asarray(off.loss_scale, jnp.float32))
-        metrics = off.host_step(jax.device_get(grads))
+        multihost = jax.process_count() > 1
+        host_grads = self._local_offload_grads(grads) if multihost \
+            else jax.device_get(grads)
+        metrics = off.host_step(host_grads)
         if not metrics["overflow"]:
             # async H2D of the updated compute-dtype params
-            new_params = off.device_params(self._state_shardings.params)
+            new_params = self._assemble_offload_params() if multihost \
+                else off.device_params(self._state_shardings.params)
             self.state = self.state.replace(
                 params=new_params,
                 step=jnp.asarray(off.step_count, jnp.int32))

@@ -55,7 +55,19 @@ class ZeroOffloadOptimizer:
                  opt_params: Dict[str, Any], schedule_fn: Callable,
                  compute_dtype, gradient_clipping: float = 0.0,
                  fp16: bool = False, scaler_cfg: Optional[Dict] = None,
-                 partition_rank: int = 0, partition_num: int = 1):
+                 partition_rank: int = 0, partition_num: int = 1,
+                 axis_divisor: Optional[int] = None,
+                 sumsq_allreduce: Optional[Callable[[float], float]] = None):
+        """``axis_divisor``: divisibility used to PICK each leaf's partition
+        axis (defaults to partition_num). The multi-host engine passes the
+        dp degree here so the host partition axis coincides with the axis
+        zero/partition.py shards the device grads on (dp is a multiple of
+        the process count, so the same axis divides both ways).
+
+        ``sumsq_allreduce``: cross-rank sum of the partition-local squared
+        grad norm; required for correct clipping when partition_num > 1
+        (each rank sees only its shard — without the reduction the clip
+        coefficients diverge and replicated leaves drift)."""
         name = (opt_name or C.ADAM_OPTIMIZER).lower()
         if name not in SUPPORTED:
             raise ValueError(
@@ -66,10 +78,15 @@ class ZeroOffloadOptimizer:
 
         self.partition_rank = int(partition_rank)
         self.partition_num = int(partition_num)
+        self.sumsq_allreduce = sumsq_allreduce
+        divisor = int(axis_divisor or self.partition_num)
+        if divisor % self.partition_num != 0:
+            raise ValueError(f"axis_divisor {divisor} must be a multiple of "
+                             f"partition_num {self.partition_num}")
         leaves, self.treedef = jax.tree_util.tree_flatten(master_params)
         self.full_shapes = [np.shape(l) for l in leaves]
         self._axes = [
-            _partition_axis(s, self.partition_num)
+            _partition_axis(s, divisor)
             if self.partition_num > 1 else None for s in self.full_shapes]
         self.masters = [
             host_f32(self.slice_leaf(i, np.asarray(l, np.float32)))
@@ -110,23 +127,26 @@ class ZeroOffloadOptimizer:
                  f"(native SIMD: {self.opt.native})", ranks=[0])
 
     # ------------------------------------------------------------------ #
-    def device_params(self, shardings=None) -> Any:
-        """Compute-dtype params for HBM (bf16 via the fused staging copy).
-        With partition_num > 1 the returned leaves are partition-local;
-        the multi-host caller owns assembling the global arrays
-        (make_array_from_process_local_data)."""
+    def local_param_leaves(self):
+        """Compute-dtype param leaves, partition-local, as host arrays
+        (bf16 via the fused staging copy — zero additional cast)."""
         import ml_dtypes
         if self.compute_dtype == jnp.bfloat16:
             if self._bf16_staging is not None and self.step_count > 0:
                 # zero-copy view of the kernel's fused down-cast output
-                leaves = [s.view(ml_dtypes.bfloat16)
-                          for s in self._bf16_staging]
-            else:
-                leaves = [m.astype(ml_dtypes.bfloat16) for m in self.masters]
-        else:
-            leaves = [m.astype(np.dtype(self.compute_dtype))
-                      for m in self.masters]
-        tree = jax.tree_util.tree_unflatten(self.treedef, leaves)
+                return [s.view(ml_dtypes.bfloat16)
+                        for s in self._bf16_staging]
+            return [m.astype(ml_dtypes.bfloat16) for m in self.masters]
+        return [m.astype(np.dtype(self.compute_dtype))
+                for m in self.masters]
+
+    def device_params(self, shardings=None) -> Any:
+        """Compute-dtype params for HBM. With partition_num > 1 the
+        returned leaves are partition-local; the multi-host engine instead
+        assembles via _assemble_offload_params (process-sharded upload +
+        XLA all-gather)."""
+        tree = jax.tree_util.tree_unflatten(self.treedef,
+                                            self.local_param_leaves())
         if shardings is not None:
             return jax.device_put(tree, shardings)
         return jax.device_put(tree)
@@ -154,11 +174,32 @@ class ZeroOffloadOptimizer:
         g_leaves = [self.slice_leaf(i, np.asarray(g, np.float32))
                     for i, g in enumerate(jax.tree_util.tree_leaves(grads))]
         inv_scale = 1.0 / self.loss_scale
-        # NOTE multi-rank (partition_num > 1): this norm is over the LOCAL
-        # partition + replicated leaves; before the multi-host engine glue
-        # lands, the ranks must all-reduce the squared norm here or clip
-        # coefficients diverge and replicated leaves drift apart.
-        grad_norm = self.opt.grad_norm(g_leaves, inv_scale)
+        if self.partition_num > 1:
+            # Partitioned leaves: every rank holds a DISJOINT shard, so the
+            # local squared norms sum across ranks. Replicated leaves are
+            # identical everywhere and contribute once, outside the
+            # reduction. Same decomposition as reference
+            # stage2.py:1371-1411's partition-then-allreduce norm.
+            part = [g for i, g in enumerate(g_leaves)
+                    if self._axes[i] is not None]
+            repl = [g for i, g in enumerate(g_leaves)
+                    if self._axes[i] is None]
+            local_sumsq = self.opt.grad_norm(part, inv_scale) ** 2
+            if self.sumsq_allreduce is not None:
+                total_sumsq = float(self.sumsq_allreduce(local_sumsq))
+            elif self.clip > 0 or self.fp16:
+                # Norm DRIVES behavior (clip coeff / overflow vote): a
+                # partition-local value would diverge across ranks and
+                # drift the replicated leaves apart.
+                raise RuntimeError(
+                    "partition_num > 1 with gradient clipping or fp16 "
+                    "requires sumsq_allreduce (cross-rank norm reduction)")
+            else:
+                total_sumsq = local_sumsq      # metric-only
+            total_sumsq += self.opt.grad_norm(repl, inv_scale) ** 2
+            grad_norm = float(np.sqrt(total_sumsq))
+        else:
+            grad_norm = self.opt.grad_norm(g_leaves, inv_scale)
         overflow = self.fp16 and not np.isfinite(grad_norm)
 
         if overflow:
