@@ -1,0 +1,70 @@
+"""Dev tool: sweep flash-attention block sizes on the bench train step.
+
+The causal flash kernel skips (q,k) blocks entirely above the diagonal, so
+smaller blocks skip more of the masked upper triangle (ceiling: 50% of
+attention FLOPs) at the cost of more per-grid-step overhead. This times the
+full bench step (chunked-CE, dots remat) per block target to find the best
+trade. Usage: python ablate_flash.py [model] [mbs] [blocks...]
+"""
+import dataclasses
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token, gpt2_init, gpt2_loss_fn
+import deepspeed_tpu.ops.flash_attention as fa
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-large"
+MBS = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+BLOCKS = [int(b) for b in sys.argv[3:]] or [1024, 512, 256]
+
+cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024,
+                          remat_policy="dots", hidden_dropout=0.0,
+                          attn_dropout=0.0, scan_layers=False)
+S = cfg.max_seq_length
+loss_fn = gpt2_loss_fn(cfg)
+tx = optax.adamw(1e-4)
+
+
+def cast(p):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 else a, p)
+
+
+def run(block):
+    fa._BLOCK_TARGET = block
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    opt_state = tx.init(params)
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch, rng):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_fn(cast(p), batch, rng))(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    batch = jnp.asarray(np.random.randint(0, cfg.vocab_size,
+                                          size=(MBS, S + 1), dtype=np.int32))
+    rng = jax.random.PRNGKey(1)
+    params, opt_state, loss = step(params, opt_state, batch, rng)
+    _ = float(loss)
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        params, opt_state, loss = step(params, opt_state, batch, rng)
+    _ = float(loss)
+    dt = (time.perf_counter() - t0) / n
+    tf = MBS * S / dt * gpt2_flops_per_token(cfg, S) / 1e12
+    print(f"block={block:5d}: {dt*1000:7.1f} ms/step  {tf:6.1f} TFLOPs "
+          f"({tf/197.0*100:.1f}% v5e peak)", flush=True)
+    del params, opt_state
+
+
+for b in BLOCKS:
+    run(b)

@@ -1,0 +1,60 @@
+"""Dev tool: sweep (fwd, bwd) flash block pairs on the fwd+bwd step only
+(no optimizer state, so gpt2-large fits).  Usage: python ablate_flash2.py
+"""
+import dataclasses
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token, gpt2_init, gpt2_loss_fn
+import deepspeed_tpu.ops.flash_attention as fa
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-large"
+MBS = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+
+cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024,
+                          remat_policy="dots", hidden_dropout=0.0,
+                          attn_dropout=0.0, scan_layers=False)
+S = cfg.max_seq_length
+loss_fn = gpt2_loss_fn(cfg)
+
+
+def cast(p):
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(cfg.dtype) if a.dtype == jnp.float32 else a, p)
+
+
+params = gpt2_init(jax.random.PRNGKey(0), cfg)
+batch = jnp.asarray(np.random.randint(0, cfg.vocab_size,
+                                      size=(MBS, S + 1), dtype=np.int32))
+rng = jax.random.PRNGKey(1)
+
+
+def run(bf, bb):
+    fa._BLOCK_TARGET = bf
+    fa._BLOCK_TARGET_BWD = bb
+
+    @jax.jit
+    def step(params, batch, rng):
+        return jax.value_and_grad(
+            lambda p: loss_fn(cast(p), batch, rng))(params)
+
+    out = step(params, batch, rng)
+    _ = float(out[0])
+    n = 20
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = step(params, batch, rng)
+    _ = float(out[0])
+    dt = (time.perf_counter() - t0) / n
+    print(f"fwd_block={bf:4d} bwd_block={bb:4d}: {dt*1000:7.1f} ms fwd+bwd",
+          flush=True)
+
+
+for bf, bb in [(1024, 1024), (512, 1024), (1024, 512), (512, 512),
+               (256, 1024)]:
+    run(bf, bb)

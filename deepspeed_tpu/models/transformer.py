@@ -252,6 +252,15 @@ def _remat_policy(name: str):
         return jax.checkpoint_policies.nothing_saveable
     if name == "dots":
         return jax.checkpoint_policies.checkpoint_dots
+    if name == "dots_flash":
+        # dots + the flash-attention kernel's (out, lse) residuals (tagged
+        # in ops.flash_attention._tag_residuals). Without the names the
+        # pallas forward kernel re-runs inside backward (+1/3 attention
+        # FLOPs); saving them costs B*S*H bf16 + B*nH*S f32 per layer.
+        return jax.checkpoint_policies.save_from_both_policies(
+            jax.checkpoint_policies.checkpoint_dots,
+            jax.checkpoint_policies.save_only_these_names(
+                "flash_out", "flash_lse"))
     if name == "attn":
         # Save only matmul outputs that feed the residual stream; recompute
         # softmax/dropout — the attn_dropout_checkpoint + gelu_checkpoint
@@ -266,7 +275,8 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
                  rng: Optional[jax.Array] = None,
                  deterministic: bool = True,
                  attention_fn: Optional[AttentionFn] = None,
-                 pld_theta: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 pld_theta: Optional[jnp.ndarray] = None,
+                 layer_valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Run all L layers via lax.scan over the stacked leading axis.
 
     ``pld_theta`` (traced scalar in (0, 1]) enables progressive layer drop
@@ -275,6 +285,11 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
     ``1 - (l+1)/L * (1 - theta)`` — deeper layers drop more often — via
     ``lax.cond``, so a dropped layer's compute is actually skipped at run
     time, not just masked. Requires ``rng``; ignored when deterministic.
+
+    ``layer_valid`` ([L] 0/1): identity-skip for PADDING layers — the
+    non-uniform-pipeline-stage mechanism (stages padded to the max layer
+    count run their pad slots as ``lax.cond`` no-ops; see
+    gpt2_pipe.gpt2_pipe_spec(stage_layers=...)).
     """
     L = stacked["ln1_scale"].shape[0]
     if rng is None:
@@ -293,28 +308,42 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
 
     use_pld = pld_theta is not None and not deterministic and use_rng
 
-    def maybe_dropped(p, h, key, layer_idx):
-        if not use_pld:
+    def maybe_dropped(p, h, key, layer_idx, valid):
+        # One combined run predicate: padding-slot validity AND the PLD
+        # keep draw; run through a single lax.cond so skipped layers cost
+        # nothing at run time.
+        run = None if valid is None else valid != 0
+        if use_pld:
+            drop_key, key = jax.random.split(key)
+            keep_prob = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * \
+                (1.0 - pld_theta)
+            keep = jax.random.bernoulli(drop_key, keep_prob)
+            run = keep if run is None else jnp.logical_and(run, keep)
+        if run is None:
             return block(p, h, rng=key if use_rng else None)
-        drop_key, blk_key = jax.random.split(key)
-        keep_prob = 1.0 - (layer_idx.astype(jnp.float32) + 1.0) / L * \
-            (1.0 - pld_theta)
-        keep = jax.random.bernoulli(drop_key, keep_prob)
-        return lax.cond(keep, lambda hh: block(p, hh, rng=blk_key),
+        return lax.cond(run,
+                        lambda hh: block(p, hh, rng=key if use_rng else None),
                         lambda hh: hh, h)
 
     if not cfg.scan_layers:
         for i in range(L):
             p_i = jax.tree_util.tree_map(lambda t: t[i], stacked)
-            x = maybe_dropped(p_i, x, keys[i], jnp.asarray(i))
+            v_i = None if layer_valid is None else layer_valid[i]
+            x = maybe_dropped(p_i, x, keys[i], jnp.asarray(i), v_i)
         return x
 
     def body(h, layer):
-        p, key, idx = layer
-        h = maybe_dropped(p, h, key, idx)
+        if layer_valid is None:
+            p, key, idx = layer
+            h = maybe_dropped(p, h, key, idx, None)
+        else:
+            p, key, idx, v = layer
+            h = maybe_dropped(p, h, key, idx, v)
         return h, None
 
-    x, _ = lax.scan(body, x, (stacked, keys, jnp.arange(L)))
+    xs = (stacked, keys, jnp.arange(L)) if layer_valid is None else \
+        (stacked, keys, jnp.arange(L), layer_valid)
+    x, _ = lax.scan(body, x, xs)
     return x
 
 

@@ -47,6 +47,14 @@ def _interpret() -> bool:
 import os
 
 _BLOCK_TARGET = int(os.environ.get("DS_FLASH_BLOCK", "1024"))
+# Backward block for CAUSAL kernels. The dq/dkv grids skip above-diagonal
+# blocks entirely, so finer blocks trade per-grid-step overhead for real
+# compute skipped; 512 measured best on v5e (gpt2-large bench sweep:
+# bwd 1024/512/256/128 -> 207.5/201.7/215.5/259.2 ms fwd+bwd). The forward
+# stays at DS_FLASH_BLOCK: it runs TWICE under remat and its per-step
+# overhead dominates the causal saving (fwd 512 -> +14 ms).
+# 0 = follow DS_FLASH_BLOCK.
+_BLOCK_TARGET_BWD = int(os.environ.get("DS_FLASH_BLOCK_BWD", "512"))
 
 
 def _pick_block(s: int, target: int = 0) -> int:
@@ -55,6 +63,12 @@ def _pick_block(s: int, target: int = 0) -> int:
         if b <= s and s % b == 0:
             return b
     return s  # small sequences: single block
+
+
+def _pick_block_bwd(s: int, causal: bool) -> int:
+    if not causal:       # no blocks to skip: finer only adds overhead
+        return _pick_block(s)
+    return _pick_block(s, _BLOCK_TARGET_BWD or _BLOCK_TARGET)
 
 
 def _run_pred(causal: bool, qi, kj, bq: int, bk: int, layout_block=None):
@@ -229,6 +243,22 @@ def _layout_spec(num_heads: int, role: str):
                         lambda b, j, i: (b % num_heads, i // 8, j // 128))
 
 
+def _qkv_spec(blk: int, D: int, role: str):
+    """Block spec for a q/k/v/do/dq/dk/dv operand over [BH, S, D] arrays.
+    ``role``: 'q' indexes the q-block dim, 'k' the k-block dim; '*T'
+    variants are for the dkv grid whose program ids are (bh, kj, qi).
+
+    NOTE a native-4D [B, S, nH, D] variant (per-head blocks (1, blk, 1, D)
+    to skip the host-side transposes) was tried and REVERTED: Mosaic
+    requires the last two block dims divisible by (8, 128) or equal to the
+    array dims, which a 1-of-nH head block can never satisfy."""
+    idx = {"q": lambda b, i, j: (b, i, 0),
+           "k": lambda b, i, j: (b, j, 0),
+           "qT": lambda b, j, i: (b, i, 0),
+           "kT": lambda b, j, i: (b, j, 0)}[role]
+    return pl.BlockSpec((1, blk, D), idx)
+
+
 def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
                dropout: float = 0.0, seed=None):
     """q,k,v: [BH, S, D]; layout int32 [H, nQ, nK] or None.
@@ -247,9 +277,9 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
                                bq=bq, bk=bk, has_layout=has_layout,
                                dropout=dropout)
     in_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        _qkv_spec(bq, D, "q"),
+        _qkv_spec(bk, D, "k"),
+        _qkv_spec(bk, D, "k"),
     ]
     args = (q, k, v)
     if dropout > 0.0:
@@ -263,7 +293,7 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
         grid=grid,
         in_specs=in_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            _qkv_spec(bq, D, "q"),
             pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
@@ -395,15 +425,15 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
     if has_layout:
         bq = bk = S // layout.shape[-1]
     else:
-        bq, bk = _pick_block(S), _pick_block(Sk)
+        bq, bk = _pick_block_bwd(S, causal), _pick_block_bwd(Sk, causal)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH, 1, S]
 
     dq_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        _qkv_spec(bq, D, "q"),
+        _qkv_spec(bk, D, "k"),
+        _qkv_spec(bk, D, "k"),
+        _qkv_spec(bq, D, "q"),
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
         pl.BlockSpec((1, 1, bq), lambda b, i, j: (b, 0, i)),
     ]
@@ -420,17 +450,17 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
                           dropout=dropout),
         grid=(BH, S // bq, Sk // bk),
         in_specs=dq_specs,
-        out_specs=pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+        out_specs=_qkv_spec(bq, D, "q"),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
         interpret=_interpret(),
     )(*dq_args)
 
     dkv_specs = [
-        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+        _qkv_spec(bq, D, "qT"),
+        _qkv_spec(bk, D, "kT"),
+        _qkv_spec(bk, D, "kT"),
+        _qkv_spec(bq, D, "qT"),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
         pl.BlockSpec((1, 1, bq), lambda b, j, i: (b, 0, i)),
     ]
@@ -448,8 +478,8 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
         grid=(BH, Sk // bk, S // bq),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            _qkv_spec(bk, D, "kT"),
+            _qkv_spec(bk, D, "kT"),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, Sk, D), k.dtype),
@@ -473,8 +503,18 @@ def _flash(q, k, v, seed, scale: float, causal: bool, dropout: float = 0.0):
     return o
 
 
+def _tag_residuals(o, lse):
+    """Name the flash residuals so remat policies can elect to SAVE them
+    (``save_only_these_names``): pallas outputs aren't ``dot_general``s, so
+    under ``checkpoint_dots`` the whole forward kernel would re-run in
+    backward. transformer._remat_policy("dots_flash") keys on these names."""
+    from jax.ad_checkpoint import checkpoint_name
+    return checkpoint_name(o, "flash_out"), checkpoint_name(lse, "flash_lse")
+
+
 def _flash_vjp_fwd(q, k, v, seed, scale, causal, dropout):
     o, lse = _flash_fwd(q, k, v, None, scale, causal, dropout, seed)
+    o, lse = _tag_residuals(o, lse)
     return o, (q, k, v, seed, o, lse)
 
 
@@ -497,6 +537,7 @@ def _flash_sparse(q, k, v, layout, seed, scale: float, causal: bool,
 
 def _flash_sparse_vjp_fwd(q, k, v, layout, seed, scale, causal, dropout):
     o, lse = _flash_fwd(q, k, v, layout, scale, causal, dropout, seed)
+    o, lse = _tag_residuals(o, lse)
     return o, (q, k, v, layout, seed, o, lse)
 
 

@@ -365,6 +365,8 @@ def _sparse_vjp_fwd(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
                     scale, causal, nH, bq, bk, dropout):
     o, lse = _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH,
                          bq, bk, dropout)
+    from .flash_attention import _tag_residuals
+    o, lse = _tag_residuals(o, lse)
     return o, (q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed, o, lse)
 
 
