@@ -233,6 +233,8 @@ PIPELINE_SEED_LAYERS = "seed_layers"
 PIPELINE_SEED_LAYERS_DEFAULT = False
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL = "activation_checkpoint_interval"
 PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT = 0
+PIPELINE_SCHEDULE = "schedule"
+PIPELINE_SCHEDULE_DEFAULT = "gpipe"
 
 #############################################
 # Gradient noise scale / progressive layer drop

@@ -13,6 +13,7 @@ from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .gpt2 import GPT2Config, gpt2_init
@@ -33,23 +34,86 @@ class PipeSpec:
     params: Dict[str, Any]
     shardings: Dict[str, Any]
     num_layers: int
+    # Set when the spec was built with explicit per-stage layer counts
+    # (identity-padded stages); the pipeline stage count is then fixed.
+    stage_layers: Any = None
+
+    def _check_stages(self, num_stages: int) -> None:
+        if self.stage_layers is not None and \
+                len(self.stage_layers) != num_stages:
+            raise ValueError(
+                f"this PipeSpec was built for {len(self.stage_layers)} "
+                f"stages (stage_layers={list(self.stage_layers)}) but the "
+                f"mesh has pp={num_stages}")
 
     def loss_fn(self, num_stages: int, num_micro: int, mesh,
                 remat: bool = True):
+        self._check_stages(num_stages)
         from ..runtime.pipe.spmd import spmd_pipeline_loss
         return spmd_pipeline_loss(self.embed_fn, self.stage_fn, self.head_fn,
                                   num_stages, num_micro, mesh, remat=remat)
 
+    def grads_fn(self, num_stages: int, num_micro: int, mesh):
+        """1F1B interleaved pipeline: returns (loss, grads) directly —
+        O(P) activation memory instead of the GPipe O(M) banks."""
+        self._check_stages(num_stages)
+        from ..runtime.pipe.spmd_1f1b import spmd_pipeline_1f1b_grads
+        return spmd_pipeline_1f1b_grads(self.embed_fn, self.stage_fn,
+                                        self.head_fn, num_stages, num_micro,
+                                        mesh)
 
-def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
-                   mp_axis: str = "model") -> PipeSpec:
+
+def pad_stacked_blocks(blocks, num_layers: int, stage_layers):
+    """Non-uniform pipeline cuts: re-stack [L, ...] blocks as
+    [P * Lmax, ...] where stage s owns slice [s*Lmax, (s+1)*Lmax) holding
+    its ``stage_layers[s]`` real layers followed by identity padding
+    (zeros; skipped at run time via the validity mask). Returns
+    (padded_blocks, valid [P*Lmax] f32) — the reference's analogue is
+    partition_balanced boundaries feeding per-rank layer builds
+    (pipe/module.py:348-404); here the padded stack keeps ONE uniform SPMD
+    stage program."""
+    stage_layers = list(stage_layers)
+    if sum(stage_layers) != num_layers:
+        raise ValueError(f"stage_layers {stage_layers} must sum to "
+                         f"{num_layers}")
+    Pn, Lmax = len(stage_layers), max(stage_layers)
+    bounds = np.cumsum([0] + stage_layers)
+
+    def pad_leaf(leaf):
+        out = jnp.zeros((Pn * Lmax,) + leaf.shape[1:], leaf.dtype)
+        for s in range(Pn):
+            lo, hi = int(bounds[s]), int(bounds[s + 1])
+            out = out.at[s * Lmax: s * Lmax + (hi - lo)].set(leaf[lo:hi])
+        return out
+
+    valid = np.zeros((Pn * Lmax,), np.float32)
+    for s in range(Pn):
+        valid[s * Lmax: s * Lmax + stage_layers[s]] = 1.0
+    return (jax.tree_util.tree_map(pad_leaf, blocks),
+            jnp.asarray(valid))
+
+
+def gpt2_pipe_spec(cfg: GPT2Config, rng=None, mp_axis: str = "model",
+                   stage_layers=None) -> PipeSpec:
+    """``stage_layers``: optional per-stage layer counts (non-uniform
+    pipeline cuts, e.g. [10, 9, 9, 8] for an embedding-heavy stage 0).
+    Stages are padded to max(stage_layers) with identity blocks that
+    lax.cond-skip at run time, keeping the SPMD stage program uniform."""
     rng = rng if rng is not None else jax.random.PRNGKey(0)
     flat = gpt2_init(rng, cfg)
+    blocks = flat["blocks"]
+    stage_valid = None       # [P, Lmax] 0/1, a CONSTANT (not a param leaf:
+    #                          weight decay must never touch it)
+    if stage_layers is not None:
+        blocks, flat_valid = pad_stacked_blocks(blocks, cfg.num_layers,
+                                                stage_layers)
+        stage_valid = jnp.reshape(flat_valid,
+                                  (len(stage_layers), max(stage_layers)))
     params = {
         "shared": {"wte": flat["wte"], "wpe": flat["wpe"],
                    "ln_f_scale": flat["ln_f_scale"],
                    "ln_f_bias": flat["ln_f_bias"]},
-        "blocks": flat["blocks"],
+        "blocks": blocks,
     }
     shardings = pipeline_param_shardings(
         shared_specs={"wte": P(mp_axis, None), "wpe": P(None, None),
@@ -62,8 +126,15 @@ def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
             shared["wpe"].astype(cfg.dtype)[None, :S]
 
     def stage_fn(blocks_local, x, rng):
+        valid = None
+        if stage_valid is not None:
+            # Inside the shard_map'd pipe region: pick this stage's mask.
+            from jax import lax as _lax
+            from ..parallel.topology import PP_AXIS
+            valid = stage_valid[_lax.axis_index(PP_AXIS)]
         return apply_blocks(blocks_local, x, cfg, rng=rng,
-                            deterministic=cfg.hidden_dropout == 0.0)
+                            deterministic=cfg.hidden_dropout == 0.0,
+                            layer_valid=valid)
 
     def head_fn(shared, x, targets, rng):
         from ..ops.cross_entropy import chunked_softmax_xent
@@ -76,4 +147,6 @@ def gpt2_pipe_spec(cfg: GPT2Config, rng=None,
 
     return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
                     params=params, shardings=shardings,
-                    num_layers=cfg.num_layers)
+                    num_layers=(cfg.num_layers if stage_layers is None else
+                                len(stage_layers) * max(stage_layers)),
+                    stage_layers=stage_layers)

@@ -63,6 +63,8 @@ class PipelineConfig:
         self.activation_checkpoint_interval = get(
             d, C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL,
             C.PIPELINE_ACTIVATION_CHECKPOINT_INTERVAL_DEFAULT)
+        self.schedule = get(d, C.PIPELINE_SCHEDULE,
+                            C.PIPELINE_SCHEDULE_DEFAULT)
 
 
 class TensorboardConfig:

@@ -78,15 +78,30 @@ class PipelineEngine(DeepSpeedEngine):
                     "for per-layer control)", ranks=[0])
             loss_fn = model.loss_fn(num_stages=pp, num_micro=m, mesh=mesh,
                                     remat=interval != 0)
+            # pipeline.schedule: "gpipe" (default — autodiff scan, O(M)
+            # boundary banks) | "1f1b" (manual interleaved fwd/bwd, O(P)
+            # activation memory — the reference TrainSchedule's profile,
+            # schedule.py:182-290). Parsed through PipelineConfig so this
+            # pre-super peek and config.pipeline_config agree.
+            from ..config import PipelineConfig
+            sched = str(PipelineConfig(
+                self._peek_param_dict(config)).schedule).lower()
+            if sched not in ("gpipe", "1f1b"):
+                raise ValueError(f"pipeline.schedule must be 'gpipe' or "
+                                 f"'1f1b', got '{sched}'")
+            gfn = model.grads_fn(num_stages=pp, num_micro=m, mesh=mesh) \
+                if sched == "1f1b" else None
             super().__init__(args=args, model=loss_fn, optimizer=optimizer,
                              model_params=model_params or model.params,
                              training_data=training_data,
                              lr_scheduler=lr_scheduler, mpu=mpu,
                              dist_init_required=dist_init_required,
                              collate_fn=collate_fn, config=config, rng=rng,
-                             mesh=mesh, param_shardings=model.shardings)
+                             mesh=mesh, param_shardings=model.shardings,
+                             grads_fn=gfn)
             log_dist(f"PipelineEngine: compiled SPMD pipeline pp={pp}, "
-                     f"micro_batches={m}, layers={model.num_layers}", ranks=[0])
+                     f"micro_batches={m}, layers={model.num_layers}, "
+                     f"schedule={sched}", ranks=[0])
             return
 
         assert isinstance(model, PipelineModule)

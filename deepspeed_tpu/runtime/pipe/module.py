@@ -249,10 +249,37 @@ class PipelineModule:
                                  "uniform stages required for pp>1")
         blocks = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
 
+        # Non-uniform stage cuts (partition_method="parameters" boundaries,
+        # or L % stages != 0): pad each stage's slice to the max stage
+        # length; pad slots lax.cond-skip at run time (identity), so the
+        # SPMD stage program stays uniform. Reference analogue:
+        # module.py:348-404's per-rank non-uniform layer builds.
+        parts = list(self.parts)
+        stage_lens = [parts[s + 1] - parts[s] for s in range(len(parts) - 1)]
+        stage_valid = None
+        if len(set(stage_lens)) > 1:
+            from ...models.gpt2_pipe import pad_stacked_blocks
+            blocks, flat_valid = pad_stacked_blocks(blocks, L, stage_lens)
+            stage_valid = jnp.reshape(
+                flat_valid, (len(stage_lens), max(stage_lens)))
+            L = len(stage_lens) * max(stage_lens)
+
         def stage_fn(blocks_local, x, rng):
-            def body(h, p):
-                return layer0(p, h), None
-            x, _ = lax.scan(body, x, blocks_local)
+            if stage_valid is None:
+                def body(h, p):
+                    return layer0(p, h), None
+                x, _ = lax.scan(body, x, blocks_local)
+                return x
+
+            from ...parallel.topology import PP_AXIS
+            valid = stage_valid[lax.axis_index(PP_AXIS)]
+
+            def body(h, pv):
+                p, v = pv
+                h = lax.cond(v != 0, lambda hh: layer0(p, hh),
+                             lambda hh: hh, h)
+                return h, None
+            x, _ = lax.scan(body, x, (blocks_local, valid))
             return x
 
         if embed_fn is None:
@@ -269,4 +296,6 @@ class PipelineModule:
             block_specs=jax.tree_util.tree_map(lambda _: P(), blocks))
         return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
                         params={"shared": {}, "blocks": blocks},
-                        shardings=shardings, num_layers=L)
+                        shardings=shardings, num_layers=L,
+                        stage_layers=(stage_lens if stage_valid is not None
+                                      else None))

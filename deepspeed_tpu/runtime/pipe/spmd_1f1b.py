@@ -1,0 +1,202 @@
+"""1F1B SPMD pipeline — O(P) activation memory, fwd/bwd interleaved.
+
+The GPipe-profile pipeline (spmd.py) banks O(M) boundary tensors: the
+embedded input bank, the last-stage output bank, and — because reverse-mode
+autodiff runs ALL forward ticks before ANY backward tick — one saved stage
+input per tick. The reference's TrainSchedule instead interleaves: each
+stage starts micro i's backward as soon as its forward chain allows, so at
+most O(P) activations are ever live (reference runtime/pipe/schedule.py:
+182-290, the 1F1B ordering).
+
+Reverse-mode autodiff CANNOT express that interleaving (it is two-phase by
+construction), so this module differentiates MANUALLY: one primal
+``lax.scan`` over M + 2(P-1) ticks computes loss AND gradients directly.
+Each tick every stage runs — uniformly, so no conditional collectives —
+
+  forward sub-tick:  embed (masked to stage 0) → stage_fn → save input in
+                     a 2P-slot ring; last stage feeds the tick's output
+                     straight into the head's value_and_grad (micro i's
+                     backward starts the same tick its forward ends);
+  backward sub-tick: re-run the stage under ``jax.vjp`` at the ring-saved
+                     input (same per-micro rng), pull the incoming
+                     cotangent through, accumulate block grads locally
+                     (they stay pipe-sharded — exactly the param layout)
+                     and tied/shared grads via an end-of-scan psum (the
+                     reference's ReduceTiedGrads, pipe/engine.py:208-227);
+  rotate:            activations ppermute up, cotangents ppermute down.
+
+Schedule (micro index as a function of tick t on stage r):
+  forward  f = t - r              (stage 0 leads)
+  head     h = t - (P-1)          (last stage, same tick as its fwd)
+  backward b = t - 2(P-1) + r     (cotangent wavefront back down)
+Ring lifetime of a saved input on stage r is 2(P-1-r) ticks, so a ring of
+R = 2P slots indexed by micro mod R never collides: O(P), independent of M.
+
+Compute parity with the remat GPipe path: both run fwd twice + bwd once
+per layer (here the re-run is inside ``jax.vjp``). The head runs on every
+stage every tick (masked off-stage) — the price of a uniform SPMD program;
+its share shrinks as L/P grows.
+
+Scope: bf16/fp32 training (fp16 loss-scaling needs the scale threaded into
+the head cotangent; the engine gates it to the GPipe path).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ...parallel.topology import PP_AXIS
+from .spmd import _split_batch, _to_micro
+
+
+def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
+                             head_fn: Callable, num_stages: int,
+                             num_micro_batches: int, mesh: Mesh) -> Callable:
+    """Build ``grads_fn(params, batch, rng) -> (mean_loss, grads)``.
+
+    Params pytree: ``{"shared": replicated-over-pipe, "blocks": stacked,
+    sharded over pipe}`` — same contract as spmd_pipeline_loss; grads come
+    back in the same structure/sharding as params.
+    """
+    M, Pstages = num_micro_batches, num_stages
+    T = M + 2 * (Pstages - 1)
+    R = 2 * Pstages                      # ring slots (>= max lifetime + 1)
+
+    def per_stage(blocks_local, shared, micro_tokens, micro_targets, rng,
+                  cdtype, xshape):
+        """Runs on every pipe rank; returns (loss_sum, dblocks, dshared)."""
+        r = lax.axis_index(PP_AXIS)
+        last = Pstages - 1
+
+        def mkey(i):
+            # Per-MICRO key (not per-tick): the backward sub-tick re-runs
+            # the stage under vjp and must regenerate identical dropout.
+            return jax.random.fold_in(jax.random.fold_in(rng, i), r)
+
+        def head_loss(sh, y, tgt, key):
+            # mean-over-micros normalization folded into the cotangent
+            return head_fn(sh, y, tgt, key).astype(jnp.float32) / M
+
+        zeros_x = jnp.zeros(xshape, cdtype)
+        carry0 = (
+            zeros_x,                                  # fwd_buf
+            zeros_x,                                  # bwd_buf (cotangent)
+            # R live slots + 1 trash slot for warmup/drain ticks whose
+            # clipped micro index must not clobber a live save.
+            jnp.zeros((R + 1,) + xshape, cdtype),     # saved-input ring
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), blocks_local),
+            jax.tree_util.tree_map(
+                lambda p: jnp.zeros(p.shape, jnp.float32), shared),
+            jnp.zeros((), jnp.float32),               # loss sum
+        )
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, ring, g_blocks, g_shared, loss_acc = carry
+
+            # ---------------- forward sub-tick ----------------
+            f = t - r
+            fc = jnp.clip(f, 0, M - 1)
+            f_ok = jnp.logical_and(f >= 0, f < M)
+            key_f = mkey(fc)
+            tok_f = lax.dynamic_index_in_dim(micro_tokens, fc, 0,
+                                             keepdims=False)
+            x0 = embed_fn(shared, tok_f, key_f).astype(cdtype)
+            x_in = jnp.where(r == 0, x0, fwd_buf)
+            y = stage_fn(blocks_local, x_in, key_f)
+            ring = lax.dynamic_update_index_in_dim(
+                ring, x_in, jnp.where(f_ok, fc % R, R), 0)
+
+            # Head + its grad on the tick's own output (last stage: micro
+            # h == f). Uniform on all stages; masked elsewhere.
+            h = t - last
+            hc = jnp.clip(h, 0, M - 1)
+            tgt_h = lax.dynamic_index_in_dim(micro_targets, hc, 0,
+                                             keepdims=False)
+            key_h = jax.random.fold_in(rng, M + hc)
+            loss_h, (dsh_head, dy) = jax.value_and_grad(
+                head_loss, argnums=(0, 1))(shared, y, tgt_h, key_h)
+            valid_h = jnp.logical_and(jnp.logical_and(h >= 0, h < M),
+                                      r == last)
+            loss_acc = loss_acc + jnp.where(valid_h, loss_h, 0.0)
+            wh = jnp.where(valid_h, 1.0, 0.0)
+            g_shared = jax.tree_util.tree_map(
+                lambda a, g: a + wh * g, g_shared, dsh_head)
+
+            # ---------------- backward sub-tick ----------------
+            b = t - 2 * last + r
+            bc = jnp.clip(b, 0, M - 1)
+            b_ok = jnp.logical_and(b >= 0, b < M)
+            key_b = mkey(bc)
+            x_saved = lax.dynamic_index_in_dim(ring, bc % R, 0,
+                                               keepdims=False)
+            g_in = jnp.where(r == last, dy.astype(cdtype), bwd_buf)
+            _, vjp = jax.vjp(
+                lambda bl, xi: stage_fn(bl, xi, key_b), blocks_local,
+                x_saved)
+            dblocks, dx = vjp(g_in)
+            wb = jnp.where(b_ok, 1.0, 0.0)
+            g_blocks = jax.tree_util.tree_map(
+                lambda a, g: a + wb * g.astype(jnp.float32),
+                g_blocks, dblocks)
+
+            # Embedding backward (tied front): stage 0 pulls its input
+            # cotangent into the shared params.
+            tok_b = lax.dynamic_index_in_dim(micro_tokens, bc, 0,
+                                             keepdims=False)
+            _, evjp = jax.vjp(
+                lambda sh: embed_fn(sh, tok_b, key_b).astype(cdtype), shared)
+            (dsh_emb,) = evjp(dx)
+            we = jnp.where(jnp.logical_and(b_ok, r == 0), 1.0, 0.0)
+            g_shared = jax.tree_util.tree_map(
+                lambda a, g: a + we * g.astype(jnp.float32),
+                g_shared, dsh_emb)
+
+            # ---------------- rotate (bf16 boundaries, as in spmd.py) ----
+            fwd_next = lax.ppermute(
+                y, PP_AXIS, [(i, i + 1) for i in range(Pstages - 1)])
+            bwd_next = lax.ppermute(
+                dx, PP_AXIS, [(i + 1, i) for i in range(Pstages - 1)])
+            return (fwd_next, bwd_next, ring, g_blocks, g_shared,
+                    loss_acc), None
+
+        (_, _, _, g_blocks, g_shared, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+        # Shared/tied grads are partial per stage (embed on 0, head on
+        # P-1); the psum is the ReduceTiedGrads collective. Loss lives on
+        # the last stage only, so the psum just broadcasts it.
+        g_shared = jax.tree_util.tree_map(
+            lambda g: lax.psum(g, PP_AXIS), g_shared)
+        loss_sum = lax.psum(loss_sum, PP_AXIS)
+        return loss_sum, g_blocks, g_shared
+
+    def grads_fn(params, batch, rng):
+        tokens, targets = _split_batch(batch)
+        micro_tokens = _to_micro(tokens, M)       # [M, mb, S]
+        micro_targets = _to_micro(targets, M)
+        shared = params["shared"]
+
+        # Embedded-activation shape (per micro-batch), via eval_shape so no
+        # FLOPs run outside the pipeline.
+        x_shape = jax.eval_shape(
+            lambda sh, tk: embed_fn(sh, tk, jax.random.PRNGKey(0)),
+            shared, jax.tree_util.tree_map(lambda a: a[0], micro_tokens))
+        cdtype = x_shape.dtype
+
+        mapped = jax.shard_map(
+            partial(per_stage, cdtype=cdtype, xshape=x_shape.shape),
+            mesh=mesh,
+            in_specs=(P(PP_AXIS), P(), P(), P(), P()),
+            out_specs=(P(), P(PP_AXIS), P()),
+            axis_names={PP_AXIS},
+            check_vma=False)
+        loss, g_blocks, g_shared = mapped(
+            params["blocks"], shared, micro_tokens, micro_targets, rng)
+        return loss, {"shared": g_shared, "blocks": g_blocks}
+
+    return grads_fn

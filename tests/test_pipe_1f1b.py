@@ -1,0 +1,146 @@
+"""1F1B interleaved SPMD pipeline: parity with sequential/GPipe and the
+O(P)-not-O(M) activation-memory contract (reference TrainSchedule,
+runtime/pipe/schedule.py:182-290)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
+from deepspeed_tpu.parallel.topology import build_mesh
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return dataclasses.replace(GPT2_CONFIGS["gpt2-tiny"], num_layers=4,
+                               hidden_dropout=0.0, attn_dropout=0.0)
+
+
+def _flat_params(spec):
+    return {**spec.params["shared"], "blocks": spec.params["blocks"]}
+
+
+class Test1F1BParity:
+    def test_loss_and_grads_match_sequential(self, cfg):
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
+        mesh = build_mesh(pp=4, dp=2)
+        M = 4
+        gfn = spec.grads_fn(num_stages=4, num_micro=M, mesh=mesh)
+        batch = jax.random.randint(jax.random.PRNGKey(1), (M * 2, 17), 0,
+                                   cfg.vocab_size)
+        with jax.set_mesh(mesh):
+            loss, grads = jax.jit(gfn)(spec.params, batch,
+                                       jax.random.PRNGKey(2))
+        want_loss = float(gpt2_loss_fn(cfg)(_flat_params(spec), batch,
+                                            jax.random.PRNGKey(2)))
+        np.testing.assert_allclose(float(loss), want_loss, rtol=2e-2)
+
+        g_seq = jax.grad(gpt2_loss_fn(cfg))(_flat_params(spec), batch,
+                                            jax.random.PRNGKey(2))
+        for k in g_seq["blocks"]:
+            np.testing.assert_allclose(
+                np.asarray(grads["blocks"][k], np.float32),
+                np.asarray(g_seq["blocks"][k], np.float32),
+                rtol=5e-2, atol=5e-3, err_msg=f"blocks/{k}")
+        # Tied wte: embed (stage 0) + unembed (last stage) contributions
+        # both arrive through the end-of-scan psum (ReduceTiedGrads).
+        np.testing.assert_allclose(
+            np.asarray(grads["shared"]["wte"], np.float32),
+            np.asarray(g_seq["wte"], np.float32), rtol=5e-2, atol=5e-3)
+        np.testing.assert_allclose(
+            np.asarray(grads["shared"]["wpe"], np.float32),
+            np.asarray(g_seq["wpe"], np.float32), rtol=5e-2, atol=5e-3)
+
+    def test_matches_gpipe_grads(self, cfg):
+        """Same pipeline, two schedules, identical grads (dropout off)."""
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(3))
+        mesh = build_mesh(pp=2, dp=1, devices=jax.devices()[:2])
+        M = 3
+        batch = jax.random.randint(jax.random.PRNGKey(4), (M * 2, 17), 0,
+                                   cfg.vocab_size)
+        loss_fn = spec.loss_fn(num_stages=2, num_micro=M, mesh=mesh)
+        gfn = spec.grads_fn(num_stages=2, num_micro=M, mesh=mesh)
+        with jax.set_mesh(mesh):
+            l_g, g_g = jax.jit(jax.value_and_grad(loss_fn))(
+                spec.params, batch, jax.random.PRNGKey(5))
+            l_i, g_i = jax.jit(gfn)(spec.params, batch, jax.random.PRNGKey(5))
+        np.testing.assert_allclose(float(l_i), float(l_g), rtol=1e-2)
+        for k in g_g["blocks"]:
+            np.testing.assert_allclose(
+                np.asarray(g_i["blocks"][k], np.float32),
+                np.asarray(g_g["blocks"][k], np.float32),
+                rtol=5e-2, atol=5e-3, err_msg=k)
+
+
+class Test1F1BMemory:
+    def test_boundary_buffers_O_P_not_O_M(self, cfg):
+        """The compiled 1F1B program must carry NO micro-batch-count-sized
+        activation bank. GPipe banks [M, mb, S, H]; 1F1B's largest
+        activation carry is the (2P+1)-slot ring — independent of M."""
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
+        P_, M, mb, S, H = 4, 16, 2, 17, cfg.hidden_size
+        mesh = build_mesh(pp=P_, dp=1, devices=jax.devices()[:P_])
+        batch = jax.random.randint(jax.random.PRNGKey(1), (M * mb, S), 0,
+                                   cfg.vocab_size)
+        rng = jax.random.PRNGKey(2)
+
+        def hlo(fn):
+            with jax.set_mesh(mesh):
+                return jax.jit(fn).lower(spec.params, batch, rng) \
+                    .compile().as_text()
+
+        bank = f"{M},{mb},{S - 1},{H}"       # [M, mb, S, H] activation bank
+        ring = f"{2 * P_ + 1},{mb},{S - 1},{H}"
+
+        txt_1f1b = hlo(spec.grads_fn(num_stages=P_, num_micro=M, mesh=mesh))
+        assert bank not in txt_1f1b, \
+            f"1F1B program still carries an O(M) activation bank [{bank}]"
+        assert ring in txt_1f1b, \
+            f"expected the O(P) saved-input ring [{ring}] in the program"
+
+        txt_gpipe = hlo(jax.value_and_grad(
+            spec.loss_fn(num_stages=P_, num_micro=M, mesh=mesh)))
+        assert bank in txt_gpipe, \
+            "sanity: the GPipe program should bank [M, mb, S, H]"
+
+
+class Test1F1BEngine:
+    def test_engine_schedule_1f1b_trains(self, cfg):
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
+        ds = {"train_batch_size": 32,
+              "train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 4,
+              "bf16": {"enabled": True},
+              "pipeline": {"schedule": "1f1b"},
+              "mesh": {"pipe_parallel_size": 2, "data_parallel_size": 4},
+              "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+              "steps_per_print": 10 ** 9}
+        engine, _, _, _ = deepspeed_tpu.initialize(config=ds, model=spec)
+        rng = np.random.default_rng(0)
+        losses = []
+        for _ in range(8):
+            batch = rng.integers(0, cfg.vocab_size, size=(32, 18),
+                                 dtype=np.int32)
+            losses.append(float(engine.train_batch(jnp.asarray(batch))))
+        assert np.isfinite(losses).all()
+        assert losses[-1] < losses[0], losses
+
+    def test_engine_rejects_fp16_1f1b(self, cfg):
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
+        ds = {"train_batch_size": 32,
+              "train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 4,
+              "fp16": {"enabled": True},
+              "pipeline": {"schedule": "1f1b"},
+              "mesh": {"pipe_parallel_size": 2, "data_parallel_size": 4},
+              "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+              "steps_per_print": 10 ** 9}
+        engine, _, _, _ = deepspeed_tpu.initialize(config=ds, model=spec)
+        batch = np.zeros((32, 18), np.int32)
+        with pytest.raises(NotImplementedError, match="1F1B"):
+            engine.train_batch(jnp.asarray(batch))

@@ -1,0 +1,144 @@
+"""Non-uniform pipeline stages: unequal layers-per-stage execute at pp>1
+via identity-padded stages (reference pipe/module.py:348-404 builds
+non-uniform per-rank layer ranges; here pad slots lax.cond-skip so the
+SPMD stage program stays uniform)."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
+from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
+from deepspeed_tpu.parallel.topology import build_mesh
+from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
+
+
+@pytest.fixture(scope="module")
+def cfg():
+    return dataclasses.replace(GPT2_CONFIGS["gpt2-tiny"], num_layers=6,
+                               hidden_dropout=0.0, attn_dropout=0.0)
+
+
+def _flat_params_unpadded(cfg, rng):
+    from deepspeed_tpu.models.gpt2 import gpt2_init
+    return gpt2_init(rng, cfg)
+
+
+class TestNonUniformGPT2:
+    def test_uneven_cuts_match_sequential(self, cfg):
+        """6 layers over 4 stages as [2, 2, 1, 1]."""
+        rng0 = jax.random.PRNGKey(0)
+        spec = gpt2_pipe_spec(cfg, rng=rng0, stage_layers=[2, 2, 1, 1])
+        assert spec.num_layers == 8          # 4 stages padded to 2
+        mesh = build_mesh(pp=4, dp=2)
+        M = 4
+        loss_fn = spec.loss_fn(num_stages=4, num_micro=M, mesh=mesh)
+        batch = jax.random.randint(jax.random.PRNGKey(1), (M * 2, 17), 0,
+                                   cfg.vocab_size)
+        with jax.set_mesh(mesh):
+            got = float(loss_fn(spec.params, batch, jax.random.PRNGKey(2)))
+        flat = _flat_params_unpadded(cfg, rng0)
+        want = float(gpt2_loss_fn(cfg)(flat, batch, jax.random.PRNGKey(2)))
+        np.testing.assert_allclose(got, want, rtol=2e-2)
+
+    def test_uneven_cuts_grads_match_sequential(self, cfg):
+        rng0 = jax.random.PRNGKey(0)
+        spec = gpt2_pipe_spec(cfg, rng=rng0, stage_layers=[2, 2, 1, 1])
+        mesh = build_mesh(pp=4, dp=2)
+        M = 4
+        loss_fn = spec.loss_fn(num_stages=4, num_micro=M, mesh=mesh)
+        batch = jax.random.randint(jax.random.PRNGKey(1), (M * 2, 17), 0,
+                                   cfg.vocab_size)
+        with jax.set_mesh(mesh):
+            g_pipe = jax.jit(jax.grad(loss_fn))(spec.params, batch,
+                                                jax.random.PRNGKey(2))
+        flat = _flat_params_unpadded(cfg, rng0)
+        g_seq = jax.grad(gpt2_loss_fn(cfg))(flat, batch,
+                                            jax.random.PRNGKey(2))
+        # Padded layout: stage s slot l holds real layer bounds[s]+l.
+        got_qkv = np.asarray(g_pipe["blocks"]["qkv_kernel"], np.float32)
+        want_qkv = np.asarray(g_seq["blocks"]["qkv_kernel"], np.float32)
+        slot_of = [0, 1, 2, 3, 4, 6]         # layer idx -> padded slot
+        for li, slot in enumerate(slot_of):
+            np.testing.assert_allclose(got_qkv[slot], want_qkv[li],
+                                       rtol=5e-2, atol=5e-3,
+                                       err_msg=f"layer {li}")
+        # Pad slots got zero grads (identity layers touch nothing).
+        for pad_slot in (5, 7):
+            assert np.abs(got_qkv[pad_slot]).max() == 0.0
+        np.testing.assert_allclose(
+            np.asarray(g_pipe["shared"]["wte"], np.float32),
+            np.asarray(g_seq["wte"], np.float32), rtol=5e-2, atol=5e-3)
+
+    def test_uneven_cuts_1f1b(self, cfg):
+        """The 1F1B schedule composes with padded stages."""
+        rng0 = jax.random.PRNGKey(0)
+        spec = gpt2_pipe_spec(cfg, rng=rng0, stage_layers=[2, 2, 1, 1])
+        mesh = build_mesh(pp=4, dp=2)
+        M = 4
+        gfn = spec.grads_fn(num_stages=4, num_micro=M, mesh=mesh)
+        batch = jax.random.randint(jax.random.PRNGKey(1), (M * 2, 17), 0,
+                                   cfg.vocab_size)
+        with jax.set_mesh(mesh):
+            loss, grads = jax.jit(gfn)(spec.params, batch,
+                                       jax.random.PRNGKey(2))
+        flat = _flat_params_unpadded(cfg, rng0)
+        want = float(gpt2_loss_fn(cfg)(flat, batch, jax.random.PRNGKey(2)))
+        np.testing.assert_allclose(float(loss), want, rtol=2e-2)
+
+    def test_engine_trains_uneven(self, cfg):
+        spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0),
+                              stage_layers=[2, 2, 1, 1])
+        ds = {"train_batch_size": 16,
+              "train_micro_batch_size_per_gpu": 2,
+              "gradient_accumulation_steps": 4,
+              "bf16": {"enabled": True},
+              "mesh": {"pipe_parallel_size": 4, "data_parallel_size": 2},
+              "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+              "steps_per_print": 10 ** 9}
+        engine, _, _, _ = deepspeed_tpu.initialize(config=ds, model=spec)
+        batch = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(16, 18), dtype=np.int32)
+        losses = [float(engine.train_batch(jnp.asarray(batch)))
+                  for _ in range(10)]
+        assert np.isfinite(losses).all()
+        assert min(losses[-3:]) < losses[0] - 0.2, losses
+
+
+def _mlp_layer(p, x):
+    return jnp.tanh(x @ p["w"] + p["b"])
+
+
+class TestNonUniformPipelineModule:
+    def test_parameters_partition_pads_and_runs_pp2(self):
+        """partition_method='parameters' over layers with unequal widths
+        gives non-uniform cuts; to_pipe_spec pads and runs pp=2."""
+        D = 8
+        module = PipelineModule(
+            layers=[_mlp_layer] * 3, num_stages=2,
+            partition_method="uniform",
+            loss_fn=lambda x, t: jnp.mean((x - t) ** 2))
+        # 3 layers over 2 stages -> [2, 1]: non-uniform by construction.
+        assert module.parts in ([0, 2, 3], [0, 1, 3])
+        rng = np.random.default_rng(0)
+        params = {f"layer_{i}":
+                  {"w": jnp.asarray(rng.normal(size=(D, D)) * 0.3,
+                                    jnp.float32),
+                   "b": jnp.zeros((D,), jnp.float32)} for i in range(3)}
+        spec = module.to_pipe_spec(params)
+        mesh = build_mesh(pp=2, dp=4)
+        M = 2
+        loss_fn = spec.loss_fn(num_stages=2, num_micro=M, mesh=mesh)
+        x = jnp.asarray(rng.normal(size=(8, D)), jnp.float32)
+        t = jnp.asarray(rng.normal(size=(8, D)), jnp.float32)
+        with jax.set_mesh(mesh):
+            got = float(loss_fn(spec.params, (x, t), jax.random.PRNGKey(0)))
+        h = x
+        for i in range(3):
+            h = _mlp_layer(params[f"layer_{i}"], h)
+        want = float(jnp.mean((h - t) ** 2))
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
