@@ -54,6 +54,90 @@ def chip_peak_tflops() -> float:
     return 197.0  # default to v5e if unknown TPU; CPU runs report vs this too
 
 
+def bench_offload_xl(gas: int = 4, n_steps: int = 2):
+    """North-star config (BASELINE.json): GPT-2 1.5B on ONE chip via
+    ZeRO-Offload — full fp32 Adam state (17 GB) in host RAM, C++ SIMD Adam,
+    bf16 grads D2H / params H2D each step. The reference's flagship
+    ZeRO-Offload claim is exactly this shape of run (13B-on-one-V100,
+    docs/_posts/2020-09-09-ZeRO-Offload.md:10).
+
+    NOT run inside the default bench: on this dev harness the chip is
+    reached through a tunnel whose D2H path measures ~0.03 GB/s (H2D ~1
+    GB/s), so each offload step pays minutes shipping grads host-ward —
+    an environment artifact, not a design cost. ``tools/offload_bench.py``
+    runs this once and records OFFLOAD_BENCH.json, which main() attaches
+    to the headline line; DS_BENCH_OFFLOAD=1 forces a live run instead."""
+    import dataclasses
+    from deepspeed_tpu.models import GPT2_CONFIGS, gpt2_init, gpt2_loss_fn
+    from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    cfg = dataclasses.replace(
+        GPT2_CONFIGS["gpt2-xl"], max_seq_length=1024,
+        remat_policy="dots", hidden_dropout=0.0, attn_dropout=0.0,
+        scan_layers=False)
+    micro_bs = 4
+    # One-chip bench by definition (the flagship claim is big-model-on-ONE-
+    # device); a full-host mesh would also break the batch triple at dp>1.
+    mesh = build_mesh(devices=jax.devices()[:1])
+    # Init the masters host-side: the offload engine keeps fp32 state in
+    # host RAM anyway, and a device init would pay 6 GB of slow D2H.
+    with jax.default_device(jax.devices("cpu")[0]):
+        params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree_util.tree_leaves(params))
+    ds_config = {
+        "train_batch_size": micro_bs * gas,
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    engine = DeepSpeedEngine(model=gpt2_loss_fn(cfg), model_params=params,
+                             config=ds_config, mesh=mesh)
+    del params
+    S = cfg.max_seq_length
+    batch = jnp.asarray(np.random.randint(
+        0, cfg.vocab_size, size=(micro_bs * gas, S + 1), dtype=np.int32))
+    engine.train_batch(batch)      # compile + first host step
+    t0 = time.perf_counter()
+    for _ in range(n_steps):
+        engine.train_batch(batch)  # offload steps are host-synchronous
+    dt = (time.perf_counter() - t0) / n_steps
+    tokens_per_sec = micro_bs * gas * S / dt
+    tflops = tokens_per_sec * gpt2_flops_per_token(cfg, S) / 1e12
+    t = engine.offload_timings or {}
+    return {
+        "offload_model": f"gpt2-xl({n_params/1e9:.2f}B)",
+        "offload_grad_accum_steps": gas,
+        "offload_tokens_per_sec": round(tokens_per_sec, 1),
+        "offload_tflops_per_chip": round(tflops, 2),
+        "offload_device_step_ms": round(t.get("device_step_ms", -1), 1),
+        "offload_d2h_ms": round(t.get("d2h_ms", -1), 1),
+        "offload_host_adam_ms": round(t.get("host_step_ms", -1), 1),
+    }
+
+
+def offload_extra():
+    """Recorded OFFLOAD_BENCH.json if present, else a live run when
+    DS_BENCH_OFFLOAD=1, else a skip marker. Never raises."""
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        rec = os.path.join(here, "OFFLOAD_BENCH.json")
+        if os.environ.get("DS_BENCH_OFFLOAD") == "1":
+            return bench_offload_xl()
+        if os.path.exists(rec):
+            with open(rec) as f:
+                return json.load(f)
+        return {"offload_skipped": "no OFFLOAD_BENCH.json; "
+                                   "set DS_BENCH_OFFLOAD=1 for a live run"}
+    except Exception as e:   # pragma: no cover - bench resilience
+        return {"offload_error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main():
     from deepspeed_tpu.models import gpt2_init, gpt2_loss_fn
     from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token
@@ -112,13 +196,18 @@ def main():
     # Reference fraction-of-peak: 64 TFLOPs on a 125 TFLOP V100 ≈ 0.512
     # (docs/_posts/2020-05-28-fastest-bert-training.md:15-16).
     ref_frac = 64.0 / 125.0
-    print(json.dumps({
+    record = {
         "metric": f"GPT2({cfg.hidden_size}x{cfg.num_layers}) train TFLOPs/chip",
         "value": round(tflops_per_chip, 2),
         "unit": f"TFLOPs/chip (bf16, {n_chips} chip(s), "
                 f"{tokens_per_sec:,.0f} tok/s, {frac_peak:.1%} of peak)",
         "vs_baseline": round(frac_peak / ref_frac, 3),
-    }))
+    }
+    if jax.devices()[0].platform == "tpu":
+        # Free the headline engine's HBM first (a live offload run needs it).
+        del engine, batch
+        record["extra"] = offload_extra()
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

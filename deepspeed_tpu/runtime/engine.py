@@ -134,10 +134,16 @@ class DeepSpeedEngine:
                  dist_init_required=None, collate_fn=None,
                  config: Union[str, Dict[str, Any], None] = None, rng=None,
                  mesh: Optional[Mesh] = None, dont_change_device: bool = False,
-                 param_shardings=None, sparse_grad_filter=None):
+                 param_shardings=None, sparse_grad_filter=None,
+                 grads_fn=None):
         if dist_init_required is None or dist_init_required:
             comm.init_distributed()
 
+        # Manually-differentiated training path: ``grads_fn(params, batch,
+        # rng) -> (loss, grads)`` replaces value_and_grad in the train step
+        # (the 1F1B pipeline computes its gradients inside one primal scan
+        # — reverse-mode autodiff can't interleave fwd/bwd ticks).
+        self._direct_grads_fn = grads_fn
         self.mpu = mpu
         self.mesh = mesh if mesh is not None else self._build_mesh(config)
         self.dp_size = int(self.mesh.shape.get(DP_AXIS, 1))
@@ -224,6 +230,15 @@ class DeepSpeedEngine:
                     partition_rank=jax.process_index(),
                     partition_num=procs, axis_divisor=divisor,
                     sumsq_allreduce=comm.host_allreduce_sum)
+            if self._direct_grads_fn is not None:
+                # train_batch routes offload configs to the offload grad
+                # pass (its own autodiff) — a direct-grads model would be
+                # silently ignored, not composed.
+                raise ValueError(
+                    "pipeline.schedule='1f1b' does not compose with "
+                    "zero_optimization.cpu_offload: the offload path "
+                    "computes grads via its own autodiff pass (use the "
+                    "gpipe schedule)")
             self._offload = ZeroOffloadOptimizer(
                 master_params, self.config.optimizer_name,
                 dict(self.config.optimizer_params or {}), self._schedule_fn,
@@ -232,6 +247,8 @@ class DeepSpeedEngine:
                 fp16=self.config.fp16_enabled, scaler_cfg=scaler_cfg,
                 **part_kwargs)
             self._offload_down = None   # lazy per-leaf process shardings
+            self._offload_down_fn = None
+            self._offload_up_fn = None
             # device params = compute-dtype cast; no device moments at all.
             # (Multi-host: master_tree() is partition-local — keep the full
             # init params for the replicated device state; the per-step
@@ -365,6 +382,7 @@ class DeepSpeedEngine:
             self._init_sparse_gradients(sparse_grad_filter)
         self._grad_step_fn = None
         self._offload_grad_fn = None
+        self.offload_timings = None   # last step's device/D2H/host breakdown
 
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
@@ -606,10 +624,31 @@ class DeepSpeedEngine:
 
         grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 
+        # Grad wire dtype: bf16 runs ship compute-dtype grads to the host
+        # (half the D2H volume; matches the reference, whose cpu_offload
+        # D2H copies the fp16 grads as-is, stage2.py:775-873). The host
+        # optimizer upcasts to fp32 before the SIMD Adam. fp32 runs keep
+        # the full-precision wire.
+        wire_dtype = compute_dtype if compute_dtype == jnp.bfloat16 \
+            else jnp.float32
+
         def grads_step(params, micro_batches, rng, step, scale):
             rng = jax.random.fold_in(rng, step)
             theta = pld.theta_at(step.astype(jnp.float32)) \
                 if accepts_pld else None
+            keys = jax.random.split(rng, gas)
+
+            if gas == 1:
+                # No accumulation buffer: saves a full fp32 zero-init +
+                # add pass AND the fp32-sized transient (for the 1.5B
+                # bench config that transient alone is 6 GB of HBM).
+                mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
+                (_, raw_loss), grads = grad_fn(params, mb, keys[0], scale,
+                                               theta)
+                grads = constrain_grads(grads)
+                return (jax.tree_util.tree_map(
+                    lambda g: g.astype(wire_dtype), grads),
+                    raw_loss.astype(jnp.float32))
 
             def accum(carry, xs):
                 g_acc, loss_acc = carry
@@ -619,13 +658,14 @@ class DeepSpeedEngine:
                     jax.tree_util.tree_map(jnp.add, g_acc, grads))
                 return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
 
-            keys = jax.random.split(rng, gas)
             zero_grads = constrain_grads(jax.tree_util.tree_map(
                 lambda p: jnp.zeros(p.shape, jnp.float32)
                 if hasattr(p, "dtype") else p, params))
             (grads, mean_loss), _ = lax.scan(
                 accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
                 (micro_batches, keys))
+            grads = jax.tree_util.tree_map(
+                lambda g: g.astype(wire_dtype), grads)
             return grads, mean_loss
 
         return jax.jit(grads_step)
@@ -640,7 +680,13 @@ class DeepSpeedEngine:
         how the dp shards were laid out."""
         procs = procs or jax.process_count()
         off = self._offload
-        devs = np.asarray(jax.devices()).reshape(procs, -1)
+        # jax.devices() is ordered by device id, which is NOT contiguous
+        # per process on all topologies; row r of the proc-mesh must be
+        # process r's devices or every host would update another host's
+        # partition.
+        devs = np.asarray(sorted(jax.devices(),
+                                 key=lambda d: (d.process_index, d.id)))
+        devs = devs.reshape(procs, -1)
         mesh = Mesh(devs, ("proc", "dev"))
         leaves, treedef = jax.tree_util.tree_flatten(
             jax.tree_util.tree_unflatten(off.treedef,
@@ -661,8 +707,12 @@ class DeepSpeedEngine:
         read the (now guaranteed-local) partition of each leaf."""
         if self._offload_down is None:
             self._offload_down = self._offload_partition_shardings()
-        grads = jax.jit(lambda t: t,
-                        out_shardings=self._offload_down)(grads)
+            # jit caches by function identity: keep ONE identity fn per
+            # direction or every step would retrace + recompile the
+            # whole-tree reshard.
+            self._offload_down_fn = jax.jit(
+                lambda t: t, out_shardings=self._offload_down)
+        grads = self._offload_down_fn(grads)
         return jax.tree_util.tree_map(
             lambda g: np.asarray(g.addressable_shards[0].data), grads)
 
@@ -678,21 +728,31 @@ class DeepSpeedEngine:
                       sh, np.ascontiguousarray(l))
                   for sh, l in zip(down_leaves, local)]
         tree = jax.tree_util.tree_unflatten(off.treedef, leaves)
-        return jax.jit(lambda t: t,
-                       out_shardings=self._state_shardings.params)(tree)
+        if self._offload_up_fn is None:
+            self._offload_up_fn = jax.jit(
+                lambda t: t, out_shardings=self._state_shardings.params)
+        return self._offload_up_fn(tree)
 
     def _train_batch_offload(self, micro_batches):
+        import time as _time
         if self._offload_grad_fn is None:
             self._offload_grad_fn = self._build_offload_grad_fn()
         off = self._offload
+        t0 = _time.perf_counter()
         grads, loss = self._offload_grad_fn(
             self.state.params, micro_batches, self._base_rng,
             jnp.asarray(self.global_steps, jnp.int32),
             jnp.asarray(off.loss_scale, jnp.float32))
+        # The loss read fences the device step; the grads fetch after it is
+        # then (close to) pure D2H — the breakdown the offload bench reports.
+        loss = jax.device_get(loss)
+        t1 = _time.perf_counter()
         multihost = jax.process_count() > 1
         host_grads = self._local_offload_grads(grads) if multihost \
             else jax.device_get(grads)
+        t2 = _time.perf_counter()
         metrics = off.host_step(host_grads)
+        t3 = _time.perf_counter()
         if not metrics["overflow"]:
             # async H2D of the updated compute-dtype params
             new_params = self._assemble_offload_params() if multihost \
@@ -702,6 +762,12 @@ class DeepSpeedEngine:
                 step=jnp.asarray(off.step_count, jnp.int32))
         self.skipped_steps = off.skipped_steps
         metrics["loss"] = loss
+        self.offload_timings = {
+            "device_step_ms": (t1 - t0) * 1e3,
+            "d2h_ms": (t2 - t1) * 1e3,
+            "host_step_ms": (t3 - t2) * 1e3,
+            "h2d_dispatch_ms": (_time.perf_counter() - t3) * 1e3,
+        }
         return metrics
 
     # ------------------------------------------------------------------ #
@@ -1015,7 +1081,15 @@ class DeepSpeedEngine:
 
     def _build_train_step(self):
         if self._onebit:
+            if self._direct_grads_fn is not None:
+                raise ValueError("grads_fn does not compose with OnebitAdam")
             return self._build_onebit_train_step()
+        direct_grads = self._direct_grads_fn
+        if direct_grads is not None and self.config.fp16_enabled:
+            raise NotImplementedError(
+                "the 1F1B/direct-grads path does not thread the fp16 loss "
+                "scale through its manual backward; use bf16, or the GPipe "
+                "schedule for fp16")
         gas = self._scan_microbatches()
         # Single-chip/single-process: the step consumes the user's flat
         # batch directly and splits micro-batches device-side.
@@ -1078,7 +1152,18 @@ class DeepSpeedEngine:
                     lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
                     micro_batches)
 
-            if gas == 1:
+            if direct_grads is not None:
+                # Manual-VJP model (1F1B pipeline): one call yields loss
+                # AND grads; it consumes all micro-batches itself. Params
+                # are pre-cast to the compute dtype like every other path
+                # (the T-tick scan would otherwise re-read fp32 masters
+                # each tick).
+                mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
+                mean_loss, grads = direct_grads(
+                    _cast_floats(state.params, compute_dtype), mb, keys[0])
+                grads = constrain_grads(grads)
+                mean_loss = mean_loss.astype(jnp.float32)
+            elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
                 # add pass over the fp32 grad tree every step.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)

@@ -23,6 +23,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running convergence tests (run by default; "
+        "deselect with -m 'not slow')")
+
+
 @pytest.fixture
 def mesh8():
     from deepspeed_tpu.parallel.topology import build_mesh

@@ -1,0 +1,123 @@
+"""End-to-end convergence: a real model learns a real task through the
+full engine stack — the reference's model-test tier (tests/model/
+run_func_test.py: train runs compared by loss curve across configs)
+re-done TPU-style on the virtual CPU mesh.
+
+Task: copy language modeling. Each sequence is ``prefix | SEP | prefix``;
+predicting the second half requires content-based attention (induction),
+so loss well below the random-prefix floor proves the transformer stack,
+engine step, optimizer, and ZeRO sharding actually learn — not just that
+loss is finite. The second-half token loss of a trained model approaches
+0; an untrained model sits at ln(V) ≈ 3.9.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_init, gpt2_loss_fn
+from deepspeed_tpu.parallel.topology import build_mesh
+
+VOCAB = 64          # tokens 0..61 data, 62 = SEP
+SEP = VOCAB - 2
+HALF = 16
+S = 2 * HALF + 1    # prefix HALF | SEP | copy HALF
+
+
+def copy_batches(n_batches: int, batch: int, seed: int):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        prefix = rng.integers(0, SEP, size=(batch, HALF), dtype=np.int32)
+        sep = np.full((batch, 1), SEP, np.int32)
+        seq = np.concatenate([prefix, sep, prefix], axis=1)   # [B, S]
+        # engine batches are [B, S+1]: inputs [:, :-1], targets [:, 1:]
+        pad = np.full((batch, 1), SEP, np.int32)
+        out.append(np.concatenate([seq, pad], axis=1))
+    return out
+
+
+def model_cfg():
+    return dataclasses.replace(
+        GPT2_CONFIGS["gpt2-tiny"], vocab_size=VOCAB, max_seq_length=S,
+        hidden_size=128, num_heads=4, num_layers=2,
+        hidden_dropout=0.0, attn_dropout=0.0, dtype=jnp.float32)
+
+
+def second_half_loss(engine, cfg, batch):
+    """Mean NLL on the copy half only — the capability metric."""
+    from deepspeed_tpu.models.gpt2 import gpt2_apply
+    params = jax.device_get(engine.state.params)
+    params = jax.tree_util.tree_map(jnp.asarray, params)
+    tokens, targets = batch[:, :-1], batch[:, 1:]
+    logits = gpt2_apply(params, jnp.asarray(tokens), cfg)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, jnp.asarray(targets)[..., None],
+                               axis=-1)[..., 0]
+    return float(jnp.mean(nll[:, HALF + 1:]))   # tokens after SEP
+
+
+def train(ds_config, steps, seed=0, dp=2):
+    cfg = model_cfg()
+    mesh = build_mesh(devices=jax.devices()[:dp])
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=gpt2_loss_fn(cfg),
+        model_params=gpt2_init(jax.random.PRNGKey(seed), cfg),
+        config=ds_config, mesh=mesh)
+    batches = copy_batches(steps, ds_config["train_batch_size"], seed=seed)
+    losses = []
+    for b in batches:
+        losses.append(float(engine.train_batch(jnp.asarray(b))))
+    return engine, cfg, losses, batches[0]
+
+
+def zero2_config(lr=3e-3):
+    return {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 16,
+        "gradient_accumulation_steps": 1,
+        "zero_optimization": {"stage": 2},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": lr}},
+        "steps_per_print": 10 ** 9,
+    }
+
+
+@pytest.mark.slow
+def test_gpt2_learns_copy_task_zero2():
+    engine, cfg, losses, probe = train(zero2_config(), steps=220)
+    # Loss must fall decisively from the ~ln(64)=4.16 floor...
+    assert losses[-1] < 2.6, f"final LM loss {losses[-1]} did not converge"
+    # ...and the copy half specifically must be LEARNED (random = 3.9+).
+    copy_nll = second_half_loss(engine, cfg, probe)
+    assert copy_nll < 0.9, f"copy-half NLL {copy_nll}: induction not learned"
+
+
+@pytest.mark.slow
+def test_convergence_parity_across_configs():
+    """The reference's run_func_test pattern: the same workload under
+    different engine configs produces matching loss curves."""
+    base = zero2_config()
+    zero0 = dict(base, zero_optimization={"stage": 0})
+    _, _, l_base, _ = train(base, steps=60)
+    _, _, l_zero0, _ = train(zero0, steps=60)
+    np.testing.assert_allclose(l_base, l_zero0, rtol=0.05, atol=0.05)
+    assert l_base[-1] < l_base[0] - 0.3
+
+
+@pytest.mark.slow
+def test_convergence_offload_matches_device():
+    """ZeRO-Offload host optimizer follows the in-graph optimizer's curve
+    on the same data (fp32 host masters vs fp32 device params)."""
+    base = zero2_config()
+    off = dict(base, train_batch_size=16, train_micro_batch_size_per_gpu=16,
+               zero_optimization={"stage": 2, "cpu_offload": True})
+    dev = dict(base, train_batch_size=16, train_micro_batch_size_per_gpu=16,
+               zero_optimization={"stage": 2})
+    _, _, l_off, _ = train(off, steps=40, dp=1)
+    _, _, l_dev, _ = train(dev, steps=40, dp=1)
+    np.testing.assert_allclose(l_off, l_dev, rtol=0.08, atol=0.08)
