@@ -563,8 +563,9 @@ def _lut_fits_smem(layout, budget_bytes: int = 384 * 1024) -> bool:
         return False
     H = lay.shape[0]
     nnz = int(lay.reshape(H, -1).sum(-1).max())
-    # qid+kid ([H, NNZ] each) for both orientations + the two nnz vectors.
-    bytes_needed = 4 * H * (4 * nnz + 2)
+    # qid+kid+kmask ([H, NNZ] each) for both orientations + the two nnz
+    # vectors (conservative: k-widening only shrinks NNZ).
+    bytes_needed = 4 * H * (6 * nnz + 2)
     return bytes_needed <= budget_bytes
 
 

@@ -39,39 +39,73 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def build_flat_luts(layout: np.ndarray):
-    """layout [H, nQ, nK] -> (qid, kid, nnz, qidT, kidT, nnzT) int32 arrays
-    ([H, NNZ] / [H]), row-major for fwd/dq and column-major for dkv; padded
-    tails repeat the last pair. None if any row/column is empty."""
+def build_flat_luts(layout: np.ndarray, widen: int = 1):
+    """layout [H, nQ, nK] -> (qid, kid, nnz, kmask, qidT, kidT, nnzT,
+    kmaskT) int32 arrays ([H, NNZ] / [H]), row-major for fwd/dq and
+    column-major for dkv; padded tails repeat the last pair. None if any
+    row/column is empty.
+
+    ``widen`` > 1 coarsens the K dimension by that factor: one LUT entry
+    covers ``widen`` adjacent 1-wide k-blocks (kid indexes WIDE blocks)
+    and ``kmask`` is a per-entry bitmask of which sub-blocks are live
+    (inactive sub-columns are softmax-masked in-kernel). Window-shaped
+    layouts (local attention bands) coarsen nearly for free, and each grid
+    step's matmuls grow ``widen``x — amortizing the fixed per-step cost
+    that dominates at head-dim 64 (see sparse_flash_attention's auto
+    pick). Padded tail entries carry kmask=0, so they are hard no-ops."""
     lay = np.asarray(layout) != 0
     H, nQ, nK = lay.shape
     if (lay.sum(-1) == 0).any() or (lay.sum(-2) == 0).any():
         return None
+    w = int(widen)
+    if nK % w != 0:
+        return None
+    nK2 = nK // w
+    # bits[h, q, k2] = bitmask of live sub-blocks in wide block k2
+    sub = lay.reshape(H, nQ, nK2, w)
+    bits = (sub.astype(np.int32) << np.arange(w, dtype=np.int32)).sum(-1)
 
-    def flatten(mask):      # row-major active pairs per head
+    def flatten(mask, bit_lookup):   # row-major active pairs per head
         pairs = [np.argwhere(mask[h]) for h in range(H)]
         nnz = np.asarray([len(p) for p in pairs], np.int32)
         NNZ = int(nnz.max())
         rid = np.zeros((H, NNZ), np.int32)
         cid = np.zeros((H, NNZ), np.int32)
+        bm = np.zeros((H, NNZ), np.int32)
         for h, p in enumerate(pairs):
             rid[h, :len(p)] = p[:, 0]
             cid[h, :len(p)] = p[:, 1]
+            bm[h, :len(p)] = bit_lookup(h, p[:, 0], p[:, 1])
             rid[h, len(p):] = p[-1, 0]
             cid[h, len(p):] = p[-1, 1]
-        return rid, cid, nnz
+            # kmask stays 0 on the padded tail: a hard no-op
+        return rid, cid, nnz, bm
 
-    qid, kid, nnz = flatten(lay)
-    kidT, qidT, nnzT = flatten(lay.transpose(0, 2, 1))
-    return qid, kid, nnz, qidT, kidT, nnzT
+    lay2 = bits != 0
+    qid, kid, nnz, kmask = flatten(lay2, lambda h, q, k2: bits[h, q, k2])
+    kidT, qidT, nnzT, kmaskT = flatten(
+        lay2.transpose(0, 2, 1), lambda h, k2, q: bits[h, q, k2])
+    return qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT
 
 
 # --------------------------------------------------------------------- #
 # Kernels — grid (BH, NNZ); state carries across same-row steps
 # --------------------------------------------------------------------- #
-def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, seed_ref,
-                 o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                 *, scale, causal, bq, bk, nH, dropout):
+def _submask(s, bits, bk: int, widen: int, transposed: bool = False):
+    """NEG_INF-mask the sub-blocks of a widened k tile whose LUT bit is 0.
+    s: [bq, bk] (or [bk, bq] transposed), bk = widen * sub_width."""
+    if widen == 1:
+        return s
+    subw = bk // widen
+    axis = 0 if transposed else 1
+    sub = jax.lax.broadcasted_iota(jnp.int32, s.shape, axis) // subw
+    live = jax.lax.shift_right_logical(bits, sub) & 1
+    return jnp.where(live == 1, s, NEG_INF)
+
+
+def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
+                 seed_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                 *, scale, causal, bq, bk, nH, dropout, widen):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     qi = qid_ref[h, n]
@@ -94,6 +128,7 @@ def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, seed_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, bq, bk)
+        s = _submask(s, kmask_ref[h, n], bk, widen)
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -125,9 +160,9 @@ def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, seed_ref,
         lse_ref[0, 0] = m_new[:, 0] + jnp.log(l_safe[:, 0])
 
 
-def _sdq_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref,
-                lse_ref, delta_ref, seed_ref, dq_ref, acc_scr,
-                *, scale, causal, bq, bk, nH, dropout):
+def _sdq_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
+                do_ref, lse_ref, delta_ref, seed_ref, dq_ref, acc_scr,
+                *, scale, causal, bq, bk, nH, dropout, widen):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     qi = qid_ref[h, n]
@@ -150,6 +185,7 @@ def _sdq_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, bq, bk)
+        s = _submask(s, kmask_ref[h, n], bk, widen)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -172,9 +208,10 @@ def _sdq_kernel(qid_ref, kid_ref, nnz_ref, q_ref, k_ref, v_ref, do_ref,
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
-def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, q_ref, k_ref, v_ref, do_ref,
-                 lse_ref, delta_ref, seed_ref, dk_ref, dv_ref,
-                 dk_scr, dv_scr, *, scale, causal, bq, bk, nH, dropout):
+def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref, k_ref,
+                 v_ref, do_ref, lse_ref, delta_ref, seed_ref, dk_ref, dv_ref,
+                 dk_scr, dv_scr, *, scale, causal, bq, bk, nH, dropout,
+                 widen):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     kj = kidT_ref[h, n]
@@ -198,6 +235,7 @@ def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, q_ref, k_ref, v_ref, do_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
+        s2 = _submask(s2, kmaskT_ref[h, n], bk, widen, transposed=True)
         p2 = jnp.exp(s2 - lse)
         if dropout > 0.0:
             keep2 = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk,
@@ -233,35 +271,36 @@ def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, q_ref, k_ref, v_ref, do_ref,
 # --------------------------------------------------------------------- #
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
-def _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH, bq, bk,
-                dropout):
+def _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal, nH, bq,
+                bk, dropout, widen):
     BH, S, D = q.shape
     NNZ = qid.shape[-1]
     kernel = functools.partial(_sfwd_kernel, scale=scale, causal=causal,
-                               bq=bq, bk=bk, nH=nH, dropout=dropout)
+                               bq=bq, bk=bk, nH=nH, dropout=dropout,
+                               widen=widen)
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(BH, NNZ),
             in_specs=[
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, qid, kid, nnz:
+                             lambda b, n, qid, kid, nnz, km:
                              (b, qid[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, qid, kid, nnz:
+                             lambda b, n, qid, kid, nnz, km:
                              (b, kid[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, qid, kid, nnz:
+                             lambda b, n, qid, kid, nnz, km:
                              (b, kid[b % nH, n], 0)),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, qid, kid, nnz:
+                             lambda b, n, qid, kid, nnz, km:
                              (b, qid[b % nH, n], 0)),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, n, qid, kid, nnz:
+                             lambda b, n, qid, kid, nnz, km:
                              (b, 0, qid[b % nH, n])),
             ],
             scratch_shapes=[
@@ -274,71 +313,86 @@ def _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH, bq, bk,
             jax.ShapeDtypeStruct((BH, 1, S), jnp.float32),
         ],
         interpret=_interpret(),
-    )(qid, kid, nnz, q, k, v, seed)
+    )(qid, kid, nnz, kmask, q, k, v, seed)
     return o, lse
 
 
 def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
-                dropout):
-    qid, kid, nnz, qidT, kidT, nnzT = luts
+                dropout, widen):
+    qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT = luts
     BH, S, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH,1,S]
 
     dq = pl.pallas_call(
         functools.partial(_sdq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nH=nH, dropout=dropout),
+                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(BH, qid.shape[-1]),
             in_specs=[
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, qi, ki, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, qi, ki, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, n, qi, ki, nz: (b, 0, qi[b % nH, n])),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, 0, qi[b % nH, n])),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, n, qi, ki, nz: (b, 0, qi[b % nH, n])),
+                             lambda b, n, qi, ki, nz, km:
+                             (b, 0, qi[b % nH, n])),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=pl.BlockSpec(
-                (1, bq, D), lambda b, n, qi, ki, nz: (b, qi[b % nH, n], 0)),
+                (1, bq, D),
+                lambda b, n, qi, ki, nz, km: (b, qi[b % nH, n], 0)),
             scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)]),
         out_shape=jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         interpret=_interpret(),
-    )(qid, kid, nnz, q, k, v, do, lse, delta, seed)
+    )(qid, kid, nnz, kmask, q, k, v, do, lse, delta, seed)
 
     dk, dv = pl.pallas_call(
         functools.partial(_sdkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nH=nH, dropout=dropout),
+                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen),
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=3,
+            num_scalar_prefetch=4,
             grid=(BH, kidT.shape[-1]),
             in_specs=[
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, ki, qi, nz: (b, qi[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bq, D),
-                             lambda b, n, ki, qi, nz: (b, qi[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, qi[b % nH, n], 0)),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, n, ki, qi, nz: (b, 0, qi[b % nH, n])),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, 0, qi[b % nH, n])),
                 pl.BlockSpec((1, 1, bq),
-                             lambda b, n, ki, qi, nz: (b, 0, qi[b % nH, n])),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, 0, qi[b % nH, n])),
                 pl.BlockSpec(memory_space=pltpu.SMEM),
             ],
             out_specs=[
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
                 pl.BlockSpec((1, bk, D),
-                             lambda b, n, ki, qi, nz: (b, ki[b % nH, n], 0)),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
             ],
             scratch_shapes=[
                 pltpu.VMEM((bk, D), jnp.float32),
@@ -349,52 +403,97 @@ def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
             jax.ShapeDtypeStruct((BH, v.shape[1], D), v.dtype),
         ],
         interpret=_interpret(),
-    )(kidT, qidT, nnzT, q, k, v, do, lse, delta, seed)
+    )(kidT, qidT, nnzT, kmaskT, q, k, v, do, lse, delta, seed)
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(10, 11, 12, 13, 14, 15))
-def _sparse_flash(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
-                  scale, causal, nH, bq, bk, dropout):
-    o, _ = _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH,
-                       bq, bk, dropout)
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(12, 13, 14, 15, 16, 17, 18))
+def _sparse_flash(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
+                  seed, scale, causal, nH, bq, bk, dropout, widen):
+    o, _ = _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal,
+                       nH, bq, bk, dropout, widen)
     return o
 
 
-def _sparse_vjp_fwd(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
-                    scale, causal, nH, bq, bk, dropout):
-    o, lse = _sparse_fwd(q, k, v, qid, kid, nnz, seed, scale, causal, nH,
-                         bq, bk, dropout)
+def _sparse_vjp_fwd(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
+                    seed, scale, causal, nH, bq, bk, dropout, widen):
+    o, lse = _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal,
+                         nH, bq, bk, dropout, widen)
     from .flash_attention import _tag_residuals
     o, lse = _tag_residuals(o, lse)
-    return o, (q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed, o, lse)
+    return o, (q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
+               seed, o, lse)
 
 
-def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, res, do):
-    q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed, o, lse = res
-    dq, dk, dv = _sparse_bwd(q, k, v, o, lse, do,
-                             (qid, kid, nnz, qidT, kidT, nnzT), seed,
-                             scale, causal, nH, bq, bk, dropout)
-    return (dq, dk, dv) + (None,) * 7
+def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, widen, res, do):
+    (q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT, seed, o,
+     lse) = res
+    dq, dk, dv = _sparse_bwd(
+        q, k, v, o, lse, do,
+        (qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT), seed,
+        scale, causal, nH, bq, bk, dropout, widen)
+    return (dq, dk, dv) + (None,) * 9
 
 
 _sparse_flash.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
 
 
+# Per-grid-step fixed cost (Mosaic sequencing latency, ~2 us on v5e),
+# expressed in block-compute units: one unit = a 128x128 tile's work, so
+# at base block b the fixed cost is ALPHA_128 * (128/b)^2 units. The auto
+# picker charges candidate widening w a cost of nnz_w * (alpha + w) and
+# takes the cheapest. Calibrated on v5e BigBird sweeps (S=32768, D=64):
+# block=128 w=1/2/4/8/16 -> 19.8/19.0/14.4/16.3/20.5 ms; block=256
+# w=1/2 -> 22.6/21.7; block=512 w=1/2 -> 17.0/19.7 — alpha=16*(128/b)^2
+# reproduces all three measured orderings.
+_WIDEN_ALPHA_128 = 16.0
+
+
+def pick_widen(layout: np.ndarray, block: int = 128,
+               choices=(1, 2, 4, 8)) -> int:
+    lay = np.asarray(layout) != 0
+    H, nQ, nK = lay.shape
+    alpha = _WIDEN_ALPHA_128 * (128.0 / max(block, 1)) ** 2
+    best_w, best_cost = 1, None
+    for w in choices:
+        if nK % w != 0:
+            continue
+        nnz_w = int(lay.reshape(H, nQ, nK // w, w).any(-1).sum())
+        cost = nnz_w * (alpha + w)
+        if best_cost is None or cost < best_cost:
+            best_w, best_cost = w, cost
+    return best_w
+
+
 def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
-                           seed=None, dropout: float = 0.0):
+                           seed=None, dropout: float = 0.0,
+                           widen: int = 0):
     """q,k,v: [BH, S, D] (batch*heads flattened); layout: CONCRETE
-    [nH, nQ, nK] array with no empty rows/columns. Grid steps == nnz."""
+    [nH, nQ, nK] array with no empty rows/columns. Grid steps == nnz of
+    the (possibly k-widened) layout.
+
+    ``widen``: 0 = auto (pick_widen cost model; DS_SPARSE_WIDEN overrides),
+    else an explicit k-coarsening factor."""
+    import os
     BH, S, D = q.shape
     nH = int(layout.shape[0])
     bq = S // layout.shape[1]
     bk = k.shape[1] // layout.shape[2]
-    luts = build_flat_luts(np.asarray(layout))
+    lay_np = np.asarray(layout)
+    if widen == 0:
+        widen = int(os.environ.get("DS_SPARSE_WIDEN", "0")) or \
+            pick_widen(lay_np, block=bq)
+    if layout.shape[2] % widen != 0:
+        widen = 1          # non-dividing override/choice: plain 1-wide LUTs
+    luts = build_flat_luts(lay_np, widen=widen)
     if luts is None:
-        raise ValueError("layout has an empty row/column; caller should "
-                         "use the gated kernel")
-    qid, kid, nnz, qidT, kidT, nnzT = (jnp.asarray(a) for a in luts)
+        raise ValueError("layout has an empty row/column (or nK % widen "
+                         "!= 0); caller should use the gated kernel")
+    (qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT) = \
+        (jnp.asarray(a) for a in luts)
     seed = jnp.zeros((1, 1), jnp.int32) if seed is None \
         else jnp.asarray(seed, jnp.int32).reshape(1, 1)
-    return _sparse_flash(q, k, v, qid, kid, nnz, qidT, kidT, nnzT, seed,
-                         scale, causal, nH, bq, bk, float(dropout))
+    return _sparse_flash(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT,
+                         kmaskT, seed, scale, causal, nH, bq, bk * widen,
+                         float(dropout), widen)

@@ -102,7 +102,7 @@ class EngineState:
     stay dependency-light and serialization-friendly."""
 
     def __init__(self, step, params, opt_state, loss_scale, growth_count, hysteresis,
-                 skipped_steps):
+                 skipped_steps, cast_params=None):
         self.step = step
         self.params = params
         self.opt_state = opt_state
@@ -110,11 +110,20 @@ class EngineState:
         self.growth_count = growth_count
         self.hysteresis = hysteresis
         self.skipped_steps = skipped_steps
+        # Persistent compute-dtype copy of ``params`` (None when the
+        # engine computes in fp32 / owns no cache): re-reading 3 GB of
+        # fp32 masters to cast them every step is pure HBM waste; the
+        # train step refreshes this cache in the same fused pass as the
+        # optimizer update, and _place_state re-derives it whenever params
+        # are replaced from outside (checkpoint load), so it can never
+        # serve stale weights.
+        self.cast_params = cast_params
 
     def replace(self, **kw) -> "EngineState":
         d = dict(step=self.step, params=self.params, opt_state=self.opt_state,
                  loss_scale=self.loss_scale, growth_count=self.growth_count,
-                 hysteresis=self.hysteresis, skipped_steps=self.skipped_steps)
+                 hysteresis=self.hysteresis, skipped_steps=self.skipped_steps,
+                 cast_params=self.cast_params)
         d.update(kw)
         return EngineState(**d)
 
@@ -122,7 +131,7 @@ class EngineState:
 jax.tree_util.register_pytree_node(
     EngineState,
     lambda s: ((s.step, s.params, s.opt_state, s.loss_scale, s.growth_count,
-                s.hysteresis, s.skipped_steps), None),
+                s.hysteresis, s.skipped_steps, s.cast_params), None),
     lambda _, ch: EngineState(*ch))
 
 
@@ -185,6 +194,14 @@ class DeepSpeedEngine:
         self._onebit = (optimizer is None and
                         (self.config.optimizer_name or "").lower() ==
                         C.ONEBIT_ADAM_OPTIMIZER)
+        # Persistent compute-dtype param cache (EngineState.cast_params):
+        # only the main train-step path consumes it; the offload/onebit/
+        # sparse paths cast inside their own programs, and fp32 compute
+        # needs no cast at all.
+        self._use_cast_cache = (
+            self.compute_dtype != jnp.float32 and not self._onebit and
+            not self.config.zero_config.cpu_offload and
+            not self.config.sparse_gradients_enabled)
         if self._onebit:
             if self.zero_optimization_stage() >= 1:
                 raise ValueError(
@@ -293,6 +310,8 @@ class DeepSpeedEngine:
         self._state_shardings = self._make_state_shardings(
             device_params, opt_shape)
         offload = self._offload is not None
+        use_cast_cache = self._use_cast_cache
+        compute_dtype = self.compute_dtype
 
         def _init_state(params):
             return EngineState(
@@ -303,6 +322,8 @@ class DeepSpeedEngine:
                 growth_count=jnp.asarray(0, jnp.int32),
                 hysteresis=jnp.asarray(hysteresis, jnp.int32),
                 skipped_steps=jnp.asarray(0, jnp.int32),
+                cast_params=_cast_floats(params, compute_dtype)
+                if use_cast_cache else None,
             )
 
         self.state = jax.jit(
@@ -505,7 +526,9 @@ class DeepSpeedEngine:
         scalar = NamedSharding(self.mesh, P())
         return EngineState(step=scalar, params=params_sh, opt_state=opt_sh,
                            loss_scale=scalar, growth_count=scalar,
-                           hysteresis=scalar, skipped_steps=scalar)
+                           hysteresis=scalar, skipped_steps=scalar,
+                           cast_params=(params_sh if self._use_cast_cache
+                                        else None))
 
     def _place_state(self, state: EngineState) -> EngineState:
         # Jitted identity, NOT device_put: device_put may alias caller-owned
@@ -513,7 +536,18 @@ class DeepSpeedEngine:
         # user's model_params out from under them. jit outputs are always
         # fresh buffers.
         state = jax.tree_util.tree_map(jnp.asarray, state)
-        return jax.jit(lambda s: s, out_shardings=self._state_shardings)(state)
+        if self._use_cast_cache:
+            # Always re-derive the compute-dtype cache here: every external
+            # params replacement (checkpoint load) funnels through this, so
+            # the cache cannot go stale.
+            dt = self.compute_dtype
+
+            def place(s):
+                return s.replace(cast_params=_cast_floats(s.params, dt))
+        else:
+            def place(s):
+                return s
+        return jax.jit(place, out_shardings=self._state_shardings)(state)
 
     def _batch_sharding(self, batch_tree, leading_dims: int = 1):
         """Shard batch arrays over dp on the (micro-)batch axis."""
@@ -1124,9 +1158,14 @@ class DeepSpeedEngine:
 
         pld = self.progressive_layer_drop
         accepts_pld = self._accepts_pld
+        use_cache = self._use_cast_cache
 
         def scaled_loss(params, mb, key, scale, theta):
-            cparams = _cast_floats(params, compute_dtype)
+            # With the cast cache, ``params`` arrive already in the compute
+            # dtype (state.cast_params); grads w.r.t. them equal the grads
+            # the cast chain would deliver (the cast vjp is a dtype-widen).
+            cparams = params if use_cache \
+                else _cast_floats(params, compute_dtype)
             out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
                 else loss_fn(cparams, mb, key)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
@@ -1152,30 +1191,32 @@ class DeepSpeedEngine:
                     lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]),
                     micro_batches)
 
+            loss_params = state.cast_params if use_cache else state.params
             if direct_grads is not None:
                 # Manual-VJP model (1F1B pipeline): one call yields loss
                 # AND grads; it consumes all micro-batches itself. Params
-                # are pre-cast to the compute dtype like every other path
-                # (the T-tick scan would otherwise re-read fp32 masters
-                # each tick).
+                # arrive in the compute dtype like every other path (the
+                # T-tick scan would otherwise re-read fp32 masters each
+                # tick).
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
                 mean_loss, grads = direct_grads(
+                    loss_params if use_cache else
                     _cast_floats(state.params, compute_dtype), mb, keys[0])
-                grads = constrain_grads(grads)
+                grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = mean_loss.astype(jnp.float32)
             elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
                 # add pass over the fp32 grad tree every step.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                (_, raw_loss), grads = grad_fn(state.params, mb, keys[0],
+                (_, raw_loss), grads = grad_fn(loss_params, mb, keys[0],
                                                scale, theta)
-                grads = constrain_grads(grads)
+                grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
                     g_acc, loss_acc = carry
                     mb, key = xs
-                    (_, raw_loss), grads = grad_fn(state.params, mb, key,
+                    (_, raw_loss), grads = grad_fn(loss_params, mb, key,
                                                    scale, theta)
                     g_acc = constrain_grads(
                         jax.tree_util.tree_map(jnp.add, g_acc, grads))
@@ -1209,12 +1250,19 @@ class DeepSpeedEngine:
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
             import optax
             new_params = optax.apply_updates(state.params, updates)
+            # Refresh the compute-dtype cache in the same fused pass as the
+            # param update (one extra compute-dtype write instead of next
+            # step's full fp32 re-read + cast).
+            new_cast = _cast_floats(new_params, compute_dtype) \
+                if use_cache else None
 
             # Overflow-skip (reference step semantics engine.py:1000-1085):
             # keep old params/opt state, don't advance step (so LR holds).
             keep = overflow
             new_params = _tree_select(keep, state.params, new_params)
             new_opt_state = _tree_select(keep, state.opt_state, new_opt_state)
+            if use_cache:
+                new_cast = _tree_select(keep, state.cast_params, new_cast)
             new_step = state.step + jnp.where(keep, 0, 1).astype(jnp.int32)
 
             # Loss-scale state machine.
@@ -1234,6 +1282,7 @@ class DeepSpeedEngine:
 
             new_state = state.replace(
                 step=new_step, params=new_params, opt_state=new_opt_state,
+                cast_params=new_cast,
                 loss_scale=new_scale, growth_count=new_growth, hysteresis=new_hyst,
                 skipped_steps=state.skipped_steps +
                 jnp.where(keep, 1, 0).astype(jnp.int32))
@@ -1514,6 +1563,13 @@ class DeepSpeedEngine:
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             import optax
             new_params = optax.apply_updates(state.params, updates)
+            # Same cache refresh as the fused train step: the next
+            # train_batch reads state.cast_params.
+            new_cast = None
+            if state.cast_params is not None:
+                new_cast = _tree_select(
+                    overflow, state.cast_params,
+                    _cast_floats(new_params, compute_dtype))
             new_params = _tree_select(overflow, state.params, new_params)
             new_opt = _tree_select(overflow, state.opt_state, new_opt)
             if fp16 and not static_scale:
@@ -1528,7 +1584,7 @@ class DeepSpeedEngine:
                 scale_fields = {}
             new_state = state.replace(
                 step=state.step + jnp.where(overflow, 0, 1).astype(jnp.int32),
-                params=new_params, opt_state=new_opt,
+                params=new_params, opt_state=new_opt, cast_params=new_cast,
                 skipped_steps=state.skipped_steps +
                 jnp.where(overflow, 1, 0).astype(jnp.int32),
                 **scale_fields)
@@ -1705,7 +1761,7 @@ class DeepSpeedEngine:
             with open(meta_file) as f:
                 meta = json.load(f)
 
-        host_state = jax.device_get(self.state)
+        host_state = jax.device_get(self.state.replace(cast_params=None))
         params_target = host_state.params if self._offload is None \
             else jax.device_get(self._offload.master_tree())
         if meta.get("pipeline_layer_files"):

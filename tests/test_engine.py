@@ -282,3 +282,44 @@ class TestEval:
         assert np.isfinite(float(loss))
         # eval does not advance counters
         assert engine.global_steps == 0
+
+
+class TestCastParamsCache:
+    """The persistent compute-dtype param cache (EngineState.cast_params)
+    must track params through EVERY mutation path — the fused train step,
+    the manual backward()+step() pair, and checkpoint load — or a later
+    train_batch silently trains against stale weights."""
+
+    def _assert_cache_fresh(self, engine):
+        import jax.numpy as jnp
+        cast = engine.state.cast_params
+        assert cast is not None
+        want = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), engine.state.params)
+        for a, b in zip(jax.tree_util.tree_leaves(cast),
+                        jax.tree_util.tree_leaves(want)):
+            np.testing.assert_array_equal(np.asarray(a, np.float32),
+                                          np.asarray(b, np.float32))
+
+    def test_train_batch_refreshes_cache(self):
+        engine = make_engine(dict(base_config(), bf16={"enabled": True}))
+        for _ in range(3):
+            engine.train_batch(batch=random_batch(n=16))
+        self._assert_cache_fresh(engine)
+
+    def test_manual_backward_step_refreshes_cache(self):
+        engine = make_engine(dict(base_config(), bf16={"enabled": True}))
+        for _ in range(3):
+            engine.forward(random_batch(n=16))
+            engine.backward()
+            engine.step()
+        self._assert_cache_fresh(engine)
+
+    def test_checkpoint_load_refreshes_cache(self, tmp_path):
+        engine = make_engine(dict(base_config(), bf16={"enabled": True}))
+        engine.train_batch(batch=random_batch(n=16))
+        engine.save_checkpoint(str(tmp_path), tag="t1")
+        engine2 = make_engine(dict(base_config(), bf16={"enabled": True}),
+                              seed=7)
+        engine2.load_checkpoint(str(tmp_path), tag="t1")
+        self._assert_cache_fresh(engine2)

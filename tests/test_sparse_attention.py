@@ -194,3 +194,41 @@ class TestSparsePallasPath:
                                False)
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-2, atol=2e-2)
+
+
+class TestWidenedKBlocks:
+    """K-widened LUT kernels (one grid step covers `widen` adjacent
+    k-blocks, dead sub-blocks softmax-masked) must match the 1-wide path
+    exactly, for outputs AND grads, with and without causal."""
+
+    @pytest.mark.parametrize("widen,causal", [(2, False), (2, True),
+                                              (4, True)])
+    def test_widened_matches_unwidened(self, widen, causal):
+        import math
+        from deepspeed_tpu.ops.sparse_flash import sparse_flash_attention
+        rng = np.random.default_rng(3)
+        nH, S, D, block = 2, 1024, 64, 128
+        nB = S // block
+        lay = (rng.random((nH, nB, nB)) < 0.3)
+        lay |= np.eye(nB, dtype=bool)[None]          # no empty rows/cols
+        lay[:, :, 0] = True
+        layout = lay.astype(np.int32)
+        q = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        scale = 1.0 / math.sqrt(D)
+
+        def loss(w):
+            def f(q, k, v):
+                o = sparse_flash_attention(q, k, v, layout, causal=causal,
+                                           scale=scale, widen=w)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return f
+
+        l1, g1 = jax.value_and_grad(loss(1), argnums=(0, 1, 2))(q, k, v)
+        lw, gw = jax.value_and_grad(loss(widen), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lw), float(l1), rtol=1e-5)
+        for a, b, name in zip(gw, g1, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name} widen={widen}")
