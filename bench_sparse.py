@@ -86,8 +86,11 @@ print(f"dense causal : {t_dense:8.1f} ms fwd+bwd", flush=True)
 for w in WIDENS:
     lay2 = np.asarray(layout) != 0
     H_, nQ_, nK_ = lay2.shape
-    nnz_w = int(lay2.reshape(H_, nQ_, nK_ // w, w).any(-1).sum()) \
-        if nK_ % w == 0 else -1
+    if nK_ % w != 0:
+        print(f"sparse w={w}  : skipped (nK={nK_} not divisible; kernel "
+              "falls back to w=1)", flush=True)
+        continue
+    nnz_w = int(lay2.reshape(H_, nQ_, nK_ // w, w).any(-1).sum())
     t = timeit(sparse_fb(w))
     print(f"sparse w={w}  : {t:8.1f} ms fwd+bwd  ({t_dense/t:4.2f}x vs "
           f"dense; steps/head ~{nnz_w//H_})", flush=True)

@@ -125,12 +125,14 @@ def _dropout_keep(seed, bh, qi, kj, bq: int, bk: int, rate: float,
 
 
 def _causal_mask(s, qi, kj, bq: int, bk: int, transposed: bool = False):
+    # Narrow iotas broadcast in the compare: one [bq,bk] pass instead of
+    # materializing two full-tile index planes.
     if transposed:
-        krows = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 0) + kj * bk
-        qcols = jax.lax.broadcasted_iota(jnp.int32, (bk, bq), 1) + qi * bq
+        krows = jax.lax.broadcasted_iota(jnp.int32, (bk, 1), 0) + kj * bk
+        qcols = jax.lax.broadcasted_iota(jnp.int32, (1, bq), 1) + qi * bq
         return jnp.where(qcols >= krows, s, NEG_INF)
-    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0) + qi * bq
-    cols = jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1) + kj * bk
+    rows = jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0) + qi * bq
+    cols = jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1) + kj * bk
     return jnp.where(rows >= cols, s, NEG_INF)
 
 
@@ -138,7 +140,8 @@ def _causal_mask(s, qi, kj, bq: int, bk: int, transposed: bool = False):
 # Forward kernel
 # --------------------------------------------------------------------- #
 def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
-                has_layout: bool, dropout: float = 0.0):
+                has_layout: bool, dropout: float = 0.0,
+                single_k: bool = False):
     if has_layout and dropout > 0.0:
         (layout_ref, seed_ref, q_ref, k_ref, v_ref, o_ref, lse_ref,
          m_scr, l_scr, acc_scr) = refs
@@ -152,6 +155,30 @@ def _fwd_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = refs
     bh, qi, kj = pl.program_id(0), pl.program_id(1), pl.program_id(2)
     nk = pl.num_programs(2)
+
+    if single_k:
+        # One k-block covers the whole row: no running-softmax state, no
+        # scratch round-trips — direct softmax + PV (saves several VPU
+        # passes; with S<=DS_FLASH_BLOCK this is the only fwd shape).
+        q, k, v = q_ref[0], k_ref[0], v_ref[0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, qi, kj, bq, bk)
+        m = jnp.max(s, axis=1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=1, keepdims=True)
+        if dropout > 0.0:
+            keep = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk, dropout)
+            p = jnp.where(keep, p * (1.0 / (1.0 - dropout)), 0.0)
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (pv / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = m[:, 0] + jnp.log(l_safe[:, 0])
+        return
 
     @pl.when(kj == 0)
     def _init():
@@ -275,7 +302,8 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, has_layout=has_layout,
-                               dropout=dropout)
+                               dropout=dropout,
+                               single_k=(Sk // bk == 1 and not has_layout))
     in_specs = [
         _qkv_spec(bq, D, "q"),
         _qkv_spec(bk, D, "k"),
@@ -417,6 +445,71 @@ def _bwd_dkv_kernel(*refs, scale: float, causal: bool, bq: int, bk: int,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
+def _bwd_fused_kernel(*refs, scale: float, causal: bool, S: int,
+                      dropout: float = 0.0):
+    """Whole-sequence fused backward: when one block covers S, compute the
+    score/softmax replay ONCE and emit dq, dk, dv together — the split
+    dq/dkv kernels each redo the s/p/exp work in their own iteration
+    order (6 matmuls + 2 softmax replays vs 5 + 1 here)."""
+    refs = list(refs)
+    seed_ref = refs.pop(0) if dropout > 0.0 else None
+    (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+     dq_ref, dk_ref, dv_ref) = refs
+    bh = pl.program_id(0)
+    q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+    lse = lse_ref[0, 0][:, None]                       # [S, 1]
+    delta = delta_ref[0, 0][:, None]                   # [S, 1]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32) * scale    # [S, S]
+    if causal:
+        s = _causal_mask(s, 0, 0, S, S)
+    p = jnp.exp(s - lse)                               # softmax replay
+    dp = jax.lax.dot_general(
+        do, v, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)            # [S, S]
+    if dropout > 0.0:
+        keep = _dropout_keep(seed_ref[0, 0], bh, 0, 0, S, S, dropout)
+        inv = 1.0 / (1.0 - dropout)
+        p_drop = jnp.where(keep, p * inv, 0.0)
+        dp = jnp.where(keep, dp * inv, 0.0)
+    else:
+        p_drop = p
+    dv_ref[0] = jax.lax.dot_general(
+        p_drop.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
+    ds = p * (dp - delta) * scale                      # [S, S]
+    dsc = ds.astype(q.dtype)
+    dq_ref[0] = jax.lax.dot_general(
+        dsc, k, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dq_ref.dtype)
+    dk_ref[0] = jax.lax.dot_general(
+        dsc, q, (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32).astype(dk_ref.dtype)
+
+
+def _flash_bwd_fused(q, k, v, lse, do, delta, scale, causal, dropout, seed):
+    BH, S, D = q.shape
+    full = pl.BlockSpec((1, S, D), lambda b: (b, 0, 0))
+    row = pl.BlockSpec((1, 1, S), lambda b: (b, 0, 0))
+    in_specs = [full, full, full, full, row, row]
+    args = (q, k, v, do, lse, delta)
+    if dropout > 0.0:
+        in_specs = [_seed_spec()] + in_specs
+        args = (_seed_arr(seed),) + args
+    return pl.pallas_call(
+        functools.partial(_bwd_fused_kernel, scale=scale, causal=causal,
+                          S=S, dropout=dropout),
+        grid=(BH,),
+        in_specs=in_specs,
+        out_specs=[pl.BlockSpec((1, S, D), lambda b: (b, 0, 0))] * 3,
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, S, D), v.dtype)],
+        interpret=_interpret(),
+    )(*args)
+
+
 def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
                dropout: float = 0.0, seed=None):
     BH, S, D = q.shape
@@ -428,6 +521,11 @@ def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
         bq, bk = _pick_block_bwd(S, causal), _pick_block_bwd(Sk, causal)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH, 1, S]
+
+    if not has_layout and S == Sk and _pick_block(S) == S and \
+            os.environ.get("DS_FLASH_FUSED_BWD", "1") == "1":
+        return _flash_bwd_fused(q, k, v, lse, do, delta, scale, causal,
+                                dropout, seed)
 
     dq_specs = [
         _qkv_spec(bq, D, "q"),

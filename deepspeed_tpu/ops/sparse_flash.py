@@ -483,7 +483,7 @@ def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
     lay_np = np.asarray(layout)
     if widen == 0:
         widen = int(os.environ.get("DS_SPARSE_WIDEN", "0")) or \
-            pick_widen(lay_np, block=bq)
+            pick_widen(lay_np, block=bk)
     if layout.shape[2] % widen != 0:
         widen = 1          # non-dividing override/choice: plain 1-wide LUTs
     luts = build_flat_luts(lay_np, widen=widen)

@@ -1489,8 +1489,9 @@ class DeepSpeedEngine:
             self.progressive_layer_drop.theta_at(self.global_steps),
             jnp.float32) if self._accepts_pld else None
         grads, raw_loss = self._grad_step_fn(
-            self.state.params, batch, self._next_rng(), self.state.loss_scale,
-            theta)
+            self.state.cast_params if self._use_cast_cache
+            else self.state.params,
+            batch, self._next_rng(), self.state.loss_scale, theta)
         self._stashed_grads = grads
         return raw_loss
 
@@ -1532,9 +1533,12 @@ class DeepSpeedEngine:
         hysteresis_init = self._hysteresis
 
         pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
+        use_cache = self._use_cast_cache
 
         def scaled_loss(params, mb, key, scale, theta):
-            cparams = _cast_floats(params, compute_dtype)
+            # forward() hands in state.cast_params when the cache is on.
+            cparams = params if use_cache \
+                else _cast_floats(params, compute_dtype)
             out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
                 else loss_fn(cparams, mb, key)
             loss, aux = (out if isinstance(out, tuple) else (out, None))
@@ -1546,7 +1550,9 @@ class DeepSpeedEngine:
 
         def grad_step(params, mb, key, scale, theta=None):
             (_, raw_loss), grads = vg(params, mb, key, scale, theta)
-            return grads, raw_loss
+            # fp32 grads regardless of compute dtype: backward() accumulates
+            # micro-batches in these, and apply_grads clips/updates in fp32.
+            return _cast_floats(grads, jnp.float32), raw_loss
 
         # ZeRO-2: grads leave the jitted backward already dp-sharded.
         grad_step = jax.jit(grad_step, out_shardings=(
