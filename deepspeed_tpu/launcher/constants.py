@@ -2,6 +2,7 @@
 
 PDSH_LAUNCHER = "pdsh"
 SSH_LAUNCHER = "ssh"
+GCLOUD_LAUNCHER = "gcloud"
 
 DEFAULT_HOSTFILE = "/job/hostfile"
 DEFAULT_COORDINATOR_PORT = 29500
@@ -13,3 +14,21 @@ EXPORT_ENV_PREFIXES = ["TPU", "JAX", "XLA", "LIBTPU", "PYTHON", "DS_"]
 # A `.deepspeed_env` file in ~ or . adds KEY=VALUE exports for all nodes
 # (reference runner.py:27-28).
 DEEPSPEED_ENVIRONMENT_NAME = ".deepspeed_env"
+
+
+def pod_index_of(host: str):
+    """Trailing integer of a hostname ('worker-3' -> 3), or None.
+
+    The single source of truth for mapping world-info hostnames to Cloud
+    TPU pod worker indices — used by BOTH the gcloud dispatcher (which
+    picks --worker=... indices) and launch._infer_node_rank (which maps a
+    worker's TPU_WORKER_ID back to its world-info rank); the two must
+    agree or ranks misalign.
+    """
+    digits = ""
+    for ch in reversed(host):
+        if ch.isdigit():
+            digits = ch + digits
+        else:
+            break
+    return int(digits) if digits else None

@@ -48,12 +48,62 @@ def _infer_node_rank(world: dict) -> int:
     for cand in (hostname, hostname.split(".")[0], "localhost"):
         if cand in hosts:
             return hosts.index(cand)
-    raise ValueError(f"host {hostname} not found in world info {hosts}")
+    # Managed TPU pod workers (gcloud dispatch): every worker runs the
+    # identical command and Cloud TPU exposes its slice index as
+    # TPU_WORKER_ID — the pod analogue of mpirun's OMPI_COMM_WORLD_RANK.
+    # A filtered launch lists a SUBSET of workers in the world info, so
+    # first match the pod index against trailing integers in the host
+    # names (worker-1, worker-3, ...), then fall back positionally.
+    wid = os.environ.get("TPU_WORKER_ID")
+    if wid is not None and wid.isdigit():
+        from .constants import pod_index_of
+        tails = [pod_index_of(h) for h in hosts]
+        if all(t is not None for t in tails):
+            # Digit-tailed world: the tails ARE the pod indices; a wid
+            # outside them means this worker was filtered out of the
+            # launch — positional fallback would duplicate a rank.
+            if int(wid) in tails:
+                return tails.index(int(wid))
+            raise ValueError(
+                f"TPU_WORKER_ID={wid} matches no world-info host {hosts}: "
+                "this worker is not part of the filtered launch")
+        if int(wid) < len(hosts):
+            return int(wid)
+        raise ValueError(
+            f"TPU_WORKER_ID={wid} out of range for world info {hosts}")
+    raise ValueError(f"host {hostname} not found in world info {hosts} "
+                     "and no usable TPU_WORKER_ID "
+                     f"(got {wid!r})")
+
+
+def _resolve_pod_coordinator(world: dict) -> str:
+    """'@pod-coordinator' sentinel: the controller has no route to managed
+    pod workers, so the coordinator address is resolved ON each worker
+    from Cloud TPU's peer list (TPU_WORKER_HOSTNAMES, comma-separated).
+    The coordinator is RANK 0 = the first world-info host; its pod index
+    (hostname tail, e.g. 'worker-3' when workers 0-2 were excluded) picks
+    the matching peer entry."""
+    from .constants import pod_index_of
+    peers = [p.strip() for p in
+             os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+             if p.strip()]
+    if not peers:
+        raise ValueError(
+            "coordinator '@pod-coordinator' needs TPU_WORKER_HOSTNAMES on "
+            "the worker (standard on Cloud TPU VMs); pass "
+            "--coordinator_addr explicitly otherwise")
+    first_host = next(iter(world.keys()))
+    idx = pod_index_of(first_host)
+    if idx is not None and idx < len(peers):
+        return peers[idx]
+    return peers[0]
 
 
 def main(args=None) -> int:
     args = parse_args(args)
     world = decode_world_info(args.world_info)
+    if args.coordinator_addr == "@pod-coordinator":
+        args.coordinator_addr = _resolve_pod_coordinator(world)
     node_rank = args.node_rank if args.node_rank >= 0 else _infer_node_rank(world)
     hosts = list(world.keys())
     assert 0 <= node_rank < len(hosts), \
@@ -89,8 +139,11 @@ def main(args=None) -> int:
         env["DS_NODE_RANK"] = str(node_rank)
         # Chip visibility when the hostfile/include filtered slots
         # (CUDA_VISIBLE_DEVICES analogue, reference launch.py:103-118).
-        env["TPU_VISIBLE_CHIPS"] = ",".join(str(s) for s in slots)
-        env["DS_LOCAL_SLOT_IDS"] = env["TPU_VISIBLE_CHIPS"]
+        # Empty slot list (placeholder topology from a hostfile-less
+        # gcloud launch) = full visibility: leave the env untouched.
+        if slots:
+            env["TPU_VISIBLE_CHIPS"] = ",".join(str(s) for s in slots)
+            env["DS_LOCAL_SLOT_IDS"] = env["TPU_VISIBLE_CHIPS"]
 
         cmd = [sys.executable, "-u", args.user_script,
                f"--local_rank={local_rank}"] + args.user_args

@@ -43,6 +43,18 @@ class MultiNodeRunner(ABC):
             self.user_script,
         ] + self.user_arguments
 
+    def _remote_shell_cmd(self, coordinator: str, node_rank_flag: str,
+                          skip_exports=()) -> str:
+        """The full remote shell line every backend dispatches: exports,
+        cd into the launch directory, then launch.py."""
+        exports = ""
+        for key, val in self.exports.items():
+            if key in skip_exports:
+                continue
+            exports += f"export {key}={shlex.quote(val)}; "
+        return exports + f"cd {os.path.abspath('.')}; " + \
+            " ".join(self._launch_cmd(coordinator, node_rank_flag))
+
 
 class PDSHRunner(MultiNodeRunner):
     """Fan out over pdsh; node rank inferred from hostname on each node."""
@@ -53,9 +65,6 @@ class PDSHRunner(MultiNodeRunner):
     def get_cmd(self, environment, active_resources, coordinator) -> List[str]:
         environment["PDSH_RCMD_TYPE"] = "ssh"
         active_workers = ",".join(active_resources.keys())
-        exports = ""
-        for key, val in self.exports.items():
-            exports += f"export {key}={shlex.quote(val)}; "
         # -S propagates the largest remote exit code into pdsh's own
         # (without it a dead worker looks like success).
         # node_rank=-1: each node matches its hostname in the world info.
@@ -63,9 +72,62 @@ class PDSHRunner(MultiNodeRunner):
             "pdsh", "-S", "-f", "1024", "-w", active_workers,
         ] + (self.args.launcher_args.split() if self.args.launcher_args
              else []) + [
-            exports + f"cd {os.path.abspath('.')}; " +
-            " ".join(self._launch_cmd(coordinator, "--node_rank=-1"))
+            self._remote_shell_cmd(coordinator, "--node_rank=-1")
         ]
+
+
+class GcloudTPURunner(MultiNodeRunner):
+    """Managed Cloud-TPU pod dispatch — the TPU-native analogue of the
+    reference's OpenMPI/MVAPICH runners (launcher/multinode_runner.py:
+    78,118): instead of mpirun over an IB fabric, one
+    ``gcloud compute tpus tpu-vm ssh --worker=all`` fans the identical
+    launch command out to every worker of a pod slice; each worker
+    resolves its node rank from the Cloud-TPU ``TPU_WORKER_ID`` env (see
+    launch._infer_node_rank), the pod analogue of OMPI_COMM_WORLD_RANK.
+
+    Requires ``--tpu_name`` (and usually ``--tpu_zone``); extra gcloud
+    flags (``--project=...``) pass through ``--launcher_args``.
+    """
+
+    def backend_exists(self) -> bool:
+        return shutil.which("gcloud") is not None
+
+    # Per-worker identity vars must NEVER be forwarded from the
+    # controller: each pod worker's own values are its rank/peer source.
+    WORKER_IDENTITY_VARS = ("TPU_WORKER_ID", "TPU_WORKER_HOSTNAMES")
+
+    @staticmethod
+    def worker_indices(active_resources) -> List[int]:
+        """Pod worker index of each active host: a trailing integer in the
+        hostname when every host has one (so include/exclude subsets keep
+        their true pod indices), else hostfile position."""
+        from .constants import pod_index_of
+        hosts = list(active_resources.keys())
+        tails = [pod_index_of(h) for h in hosts]
+        if all(t is not None for t in tails) and len(set(tails)) == len(tails):
+            return tails
+        return list(range(len(hosts)))
+
+    def get_cmd(self, environment, active_resources, coordinator) -> List[str]:
+        if not getattr(self.args, "tpu_name", None):
+            raise ValueError("--launcher=gcloud requires --tpu_name "
+                             "(the Cloud TPU pod slice to dispatch onto)")
+        remote = self._remote_shell_cmd(
+            coordinator, "--node_rank=-1",
+            skip_exports=self.WORKER_IDENTITY_VARS)
+        # Dispatch ONLY the active workers (never --worker=all: an
+        # include/exclude/num_nodes filter would otherwise start excluded
+        # workers, which rank themselves out of range and fail the job).
+        workers = ",".join(
+            str(i) for i in self.worker_indices(active_resources))
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm", "ssh",
+               self.args.tpu_name, f"--worker={workers}",
+               f"--command={remote}"]
+        if getattr(self.args, "tpu_zone", None):
+            cmd.insert(5, f"--zone={self.args.tpu_zone}")
+        if self.args.launcher_args:
+            cmd += self.args.launcher_args.split()
+        return cmd
 
 
 class SSHRunner(MultiNodeRunner):
@@ -76,13 +138,10 @@ class SSHRunner(MultiNodeRunner):
         return shutil.which("ssh") is not None
 
     def get_cmd(self, environment, active_resources, coordinator) -> List[str]:
-        exports = ""
-        for key, val in self.exports.items():
-            exports += f"export {key}={shlex.quote(val)}; "
         cmds = []
         for rank, host in enumerate(active_resources.keys()):
-            remote = exports + f"cd {os.path.abspath('.')}; " + \
-                " ".join(self._launch_cmd(coordinator, f"--node_rank={rank}"))
+            remote = self._remote_shell_cmd(coordinator,
+                                            f"--node_rank={rank}")
             cmds.append(f"ssh {host} {shlex.quote(remote)}")
         # Fan out, wait for each, and exit with a nonzero code if ANY host
         # failed (plain `wait` would always return 0 and mask dead jobs).

@@ -28,7 +28,8 @@ from copy import deepcopy
 from typing import Dict, List, Optional
 
 from .constants import (DEEPSPEED_ENVIRONMENT_NAME, DEFAULT_COORDINATOR_PORT,
-                        DEFAULT_HOSTFILE, EXPORT_ENV_PREFIXES, PDSH_LAUNCHER,
+                        DEFAULT_HOSTFILE, EXPORT_ENV_PREFIXES, GCLOUD_LAUNCHER,
+                        PDSH_LAUNCHER,
                         SSH_LAUNCHER)
 from ..utils.logging import logger
 
@@ -54,7 +55,12 @@ def parse_args(args=None):
     parser.add_argument("--coordinator_addr", "--master_addr",
                         dest="coordinator_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default=PDSH_LAUNCHER,
-                        help=f"{PDSH_LAUNCHER} | {SSH_LAUNCHER}")
+                        help=f"{PDSH_LAUNCHER} | {SSH_LAUNCHER} | "
+                             f"{GCLOUD_LAUNCHER}")
+    parser.add_argument("--tpu_name", type=str, default=None,
+                        help="Cloud TPU pod slice name (gcloud launcher)")
+    parser.add_argument("--tpu_zone", type=str, default=None,
+                        help="Cloud TPU zone (gcloud launcher)")
     parser.add_argument("--launcher_args", type=str, default="")
     parser.add_argument("--procs_per_node", type=int, default=1,
                         help="worker processes per host (1 for TPU: JAX owns "
@@ -187,6 +193,11 @@ def decode_world_info(world_info_base64: str) -> Dict[str, List[int]]:
 def _resolve_coordinator(active_resources, args) -> str:
     if args.coordinator_addr:
         return args.coordinator_addr
+    if getattr(args, "launcher", "").lower() == GCLOUD_LAUNCHER:
+        # No direct ssh route to managed pod workers (that is the whole
+        # point of the gcloud wrapper): defer resolution to the workers,
+        # which read the coordinator from TPU_WORKER_HOSTNAMES.
+        return "@pod-coordinator"
     first_host = next(iter(active_resources))
     if first_host in ("localhost", "127.0.0.1"):
         return "127.0.0.1"
@@ -228,6 +239,20 @@ def main(args=None) -> int:
                            "to hostfile/local")
     if resource_pool is None:
         resource_pool = fetch_hostfile(args.hostfile)
+    if resource_pool is None and \
+            args.launcher.lower() == GCLOUD_LAUNCHER:
+        # Managed pod dispatch needs no hostfile — the pod's workers ARE
+        # the topology. --num_nodes supplies the worker count (hostnames
+        # are placeholders; workers rank themselves via TPU_WORKER_ID).
+        if args.num_nodes <= 0:
+            raise ValueError(
+                "--launcher gcloud without a hostfile requires "
+                "--num_nodes=<pod worker count>")
+        # Slot count 0 = empty slot list = full chip visibility on each
+        # worker (launch.py only masks TPU_VISIBLE_CHIPS for real slots).
+        resource_pool = collections.OrderedDict(
+            (f"worker-{i}", args.num_chips if args.num_chips > 0 else 0)
+            for i in range(args.num_nodes))
     multi_node_exec = resource_pool is not None and len(resource_pool) > 0
     if not resource_pool:
         # local fallback: all chips of this host
@@ -255,7 +280,8 @@ def main(args=None) -> int:
     env = os.environ.copy()
     coordinator = _resolve_coordinator(active_resources, args)
     world_info_base64 = encode_world_info(active_resources)
-    multi_node_exec = args.force_multi or len(active_resources) > 1
+    multi_node_exec = args.force_multi or len(active_resources) > 1 or \
+        args.launcher.lower() == GCLOUD_LAUNCHER   # always dispatch to pods
 
     if not multi_node_exec:
         cmd = [sys.executable, "-u", "-m", "deepspeed_tpu.launcher.launch",
@@ -266,11 +292,14 @@ def main(args=None) -> int:
                "--node_rank=0",
                args.user_script] + args.user_args
     else:
-        from .multinode_runner import PDSHRunner, SSHRunner
+        from .multinode_runner import (GcloudTPURunner, PDSHRunner,
+                                       SSHRunner)
         if args.launcher.lower() == PDSH_LAUNCHER:
             runner = PDSHRunner(args, world_info_base64)
         elif args.launcher.lower() == SSH_LAUNCHER:
             runner = SSHRunner(args, world_info_base64)
+        elif args.launcher.lower() == GCLOUD_LAUNCHER:
+            runner = GcloudTPURunner(args, world_info_base64)
         else:
             raise NotImplementedError(f"Unknown launcher {args.launcher}")
         if not runner.backend_exists():
