@@ -149,9 +149,21 @@ class DeepSpeedEngine:
             comm.init_distributed()
 
         # Manually-differentiated training path: ``grads_fn(params, batch,
-        # rng) -> (loss, grads)`` replaces value_and_grad in the train step
-        # (the 1F1B pipeline computes its gradients inside one primal scan
-        # — reverse-mode autodiff can't interleave fwd/bwd ticks).
+        # rng, scale) -> (unscaled_loss, scale-multiplied grads)`` replaces
+        # value_and_grad in the train step (the 1F1B pipeline computes its
+        # gradients inside one primal scan — reverse-mode autodiff can't
+        # interleave fwd/bwd ticks). ``scale`` is the fp16 loss scale (a
+        # traced 1.0 otherwise); a 3-arg fn is accepted for scale-oblivious
+        # models (bf16/fp32 only).
+        if grads_fn is not None:
+            import inspect
+            try:
+                n_params = len(inspect.signature(grads_fn).parameters)
+            except (TypeError, ValueError):
+                n_params = 4
+            if n_params < 4:
+                _inner_grads_fn = grads_fn
+                grads_fn = lambda p, b, r, scale: _inner_grads_fn(p, b, r)
         self._direct_grads_fn = grads_fn
         self.mpu = mpu
         self.mesh = mesh if mesh is not None else self._build_mesh(config)
@@ -1119,11 +1131,6 @@ class DeepSpeedEngine:
                 raise ValueError("grads_fn does not compose with OnebitAdam")
             return self._build_onebit_train_step()
         direct_grads = self._direct_grads_fn
-        if direct_grads is not None and self.config.fp16_enabled:
-            raise NotImplementedError(
-                "the 1F1B/direct-grads path does not thread the fp16 loss "
-                "scale through its manual backward; use bf16, or the GPipe "
-                "schedule for fp16")
         gas = self._scan_microbatches()
         # Single-chip/single-process: the step consumes the user's flat
         # batch directly and splits micro-batches device-side.
@@ -1201,7 +1208,8 @@ class DeepSpeedEngine:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
                 mean_loss, grads = direct_grads(
                     loss_params if use_cache else
-                    _cast_floats(state.params, compute_dtype), mb, keys[0])
+                    _cast_floats(state.params, compute_dtype), mb, keys[0],
+                    scale)
                 grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = mean_loss.astype(jnp.float32)
             elif gas == 1:

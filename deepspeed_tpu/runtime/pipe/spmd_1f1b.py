@@ -37,8 +37,12 @@ per layer (here the re-run is inside ``jax.vjp``). The head runs on every
 stage every tick (masked off-stage) — the price of a uniform SPMD program;
 its share shrinks as L/P grows.
 
-Scope: bf16/fp32 training (fp16 loss-scaling needs the scale threaded into
-the head cotangent; the engine gates it to the GPipe path).
+fp16 loss scaling: the engine passes its (traced) loss scale; the head
+loss is multiplied by it inside the tick, so every cotangent flowing down
+the pipe — and every accumulated gradient — is scaled exactly as the
+autodiff path's scaled-loss trick produces, and the engine's existing
+unscale + overflow-vote machinery applies unchanged. The RETURNED loss is
+unscaled (scale is a power of two; the division is exact).
 """
 from __future__ import annotations
 
@@ -57,18 +61,20 @@ from .spmd import _split_batch, _to_micro
 def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
                              head_fn: Callable, num_stages: int,
                              num_micro_batches: int, mesh: Mesh) -> Callable:
-    """Build ``grads_fn(params, batch, rng) -> (mean_loss, grads)``.
+    """Build ``grads_fn(params, batch, rng, scale=None) ->
+    (unscaled_mean_loss, scale-multiplied grads)``.
 
     Params pytree: ``{"shared": replicated-over-pipe, "blocks": stacked,
     sharded over pipe}`` — same contract as spmd_pipeline_loss; grads come
-    back in the same structure/sharding as params.
+    back in the same structure/sharding as params. ``scale`` is the fp16
+    loss scale (defaults to 1.0, where grads are plain gradients).
     """
     M, Pstages = num_micro_batches, num_stages
     T = M + 2 * (Pstages - 1)
     R = 2 * Pstages                      # ring slots (>= max lifetime + 1)
 
     def per_stage(blocks_local, shared, micro_tokens, micro_targets, rng,
-                  cdtype, xshape):
+                  scale, cdtype, xshape):
         """Runs on every pipe rank; returns (loss_sum, dblocks, dshared)."""
         r = lax.axis_index(PP_AXIS)
         last = Pstages - 1
@@ -79,8 +85,11 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
             return jax.random.fold_in(jax.random.fold_in(rng, i), r)
 
         def head_loss(sh, y, tgt, key):
-            # mean-over-micros normalization folded into the cotangent
-            return head_fn(sh, y, tgt, key).astype(jnp.float32) / M
+            # mean-over-micros normalization AND the fp16 loss scale are
+            # folded into the cotangent here — everything downstream
+            # (dy, dx, dblocks, dshared) comes out scaled, exactly like
+            # the autodiff path's scaled-loss trick.
+            return head_fn(sh, y, tgt, key).astype(jnp.float32) * scale / M
 
         zeros_x = jnp.zeros(xshape, cdtype)
         carry0 = (
@@ -175,7 +184,8 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
         loss_sum = lax.psum(loss_sum, PP_AXIS)
         return loss_sum, g_blocks, g_shared
 
-    def grads_fn(params, batch, rng):
+    def grads_fn(params, batch, rng, scale=None):
+        scale = jnp.asarray(1.0, jnp.float32) if scale is None else scale
         tokens, targets = _split_batch(batch)
         micro_tokens = _to_micro(tokens, M)       # [M, mb, S]
         micro_targets = _to_micro(targets, M)
@@ -191,12 +201,16 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
         mapped = jax.shard_map(
             partial(per_stage, cdtype=cdtype, xshape=x_shape.shape),
             mesh=mesh,
-            in_specs=(P(PP_AXIS), P(), P(), P(), P()),
+            in_specs=(P(PP_AXIS), P(), P(), P(), P(), P()),
             out_specs=(P(), P(PP_AXIS), P()),
             axis_names={PP_AXIS},
             check_vma=False)
         loss, g_blocks, g_shared = mapped(
-            params["blocks"], shared, micro_tokens, micro_targets, rng)
-        return loss, {"shared": g_shared, "blocks": g_blocks}
+            params["blocks"], shared, micro_tokens, micro_targets, rng,
+            scale)
+        # Grads stay SCALED (the engine unscales + overflow-votes, same as
+        # its autodiff path); the reported loss is unscaled — scale is a
+        # power of two, so the division is exact.
+        return loss / scale, {"shared": g_shared, "blocks": g_blocks}
 
     return grads_fn

@@ -136,14 +136,25 @@ class Test1F1BEngine:
         assert np.isfinite(losses).all()
         assert losses[-1] < losses[0], losses
 
-    def test_engine_rejects_fp16_1f1b(self, cfg):
+    def test_engine_fp16_1f1b_trains_with_loss_scaling(self, cfg):
+        """fp16 + 1F1B: the scale rides the head cotangent through the
+        manual backward; the engine's unscale/overflow machinery applies."""
         spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
-        ds = _1f1b_ds_config(fp16={"enabled": True})
+        ds = _1f1b_ds_config(
+            fp16={"enabled": True, "initial_scale_power": 8,
+                  "loss_scale_window": 4},
+            optimizer={"type": "AdamW", "params": {"lr": 5e-3}})
         del ds["bf16"]
         engine, _, _, _ = deepspeed_tpu.initialize(config=ds, model=spec)
-        batch = np.zeros((32, 18), np.int32)
-        with pytest.raises(NotImplementedError, match="1F1B"):
-            engine.train_batch(jnp.asarray(batch))
+        batch = np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(32, 18), dtype=np.int32)
+        losses = [float(engine.train_batch(jnp.asarray(batch)))
+                  for _ in range(8)]
+        assert np.isfinite(losses).all(), losses
+        assert min(losses[-3:]) < losses[0] - 0.2, losses
+        # The reported loss must be UNSCALED (scale starts at 2^8; a
+        # scaled report would sit around ln(V)*256).
+        assert losses[0] < 20.0, losses
 
     def test_engine_1f1b_composes_with_zero1(self, cfg):
         """1F1B direct grads + ZeRO-1 (dp-sharded optimizer state): the
