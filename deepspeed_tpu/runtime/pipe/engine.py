@@ -105,6 +105,16 @@ class PipelineEngine(DeepSpeedEngine):
             return
 
         assert isinstance(model, PipelineModule)
+        # Validate the schedule key on this branch too: '1f1b' needs the
+        # PipeSpec path — silently training un-pipelined would be a trap.
+        from ..config import PipelineConfig
+        sched = str(PipelineConfig(
+            self._peek_param_dict(config)).schedule).lower()
+        if sched == "1f1b":
+            raise NotImplementedError(
+                "pipeline.schedule='1f1b' requires a PipeSpec model "
+                "(models/gpt2_pipe.py); PipelineModule layer lists run "
+                "composed (pp=1) and have no interleaved schedule")
         self.pipeline_module = model
         if model_params is None:
             model_params = self._init_layer_params(model, training_data, rng0,

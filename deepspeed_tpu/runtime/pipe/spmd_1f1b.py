@@ -33,9 +33,26 @@ Ring lifetime of a saved input on stage r is 2(P-1-r) ticks, so a ring of
 R = 2P slots indexed by micro mod R never collides: O(P), independent of M.
 
 Compute parity with the remat GPipe path: both run fwd twice + bwd once
-per layer (here the re-run is inside ``jax.vjp``). The head runs on every
-stage every tick (masked off-stage) — the price of a uniform SPMD program;
-its share shrinks as L/P grows.
+per layer (here the re-run is inside ``jax.vjp``). Each sub-tick (embed
+fwd, stage fwd, head, stage bwd, embed bwd) is ``lax.cond``-gated on a
+predicate that is a function of the TICK INDEX ONLY — uniform across
+devices — so warmup/drain ticks skip the work they cannot use. Uniformity
+is load-bearing: a per-RANK predicate (e.g. ``r == last`` for the head)
+puts the partitioner-inserted dp/mp collectives of the branch body on
+some devices' execution paths and not others', and the program deadlocks
+at the next collective rendezvous (observed on the 8-device dryrun:
+ranks waiting on different op_ids of the same scan). Per-rank validity is
+therefore applied INSIDE the branch as ``jnp.where`` selects — a select
+DISCARDS the masked side, so a warmup/drain tick's inf/NaN (plausible
+under fp16: the head/vjp sees stale buffers) cannot poison the
+accumulators the way multiplicative ``0*g`` masking could.
+
+Wall-clock: in a lockstep pipeline the off-stage work that remains (the
+head on non-last ranks during the M central ticks) runs in PARALLEL with
+the real head on the last rank — it wastes chip-FLOPs, not tick latency.
+The reclaimable latency is the warmup/drain sub-ticks, which the uniform
+gates remove; ablate_1f1b_gate.py measures it. ``gate_offstage=False``
+recovers the ungated run-everything-and-select variant.
 
 fp16 loss scaling: the engine passes its (traced) loss scale; the head
 loss is multiplied by it inside the tick, so every cotangent flowing down
@@ -60,7 +77,8 @@ from .spmd import _split_batch, _to_micro
 
 def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
                              head_fn: Callable, num_stages: int,
-                             num_micro_batches: int, mesh: Mesh) -> Callable:
+                             num_micro_batches: int, mesh: Mesh,
+                             gate_offstage: bool = True) -> Callable:
     """Build ``grads_fn(params, batch, rng, scale=None) ->
     (unscaled_mean_loss, scale-multiplied grads)``.
 
@@ -68,6 +86,11 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
     sharded over pipe}`` — same contract as spmd_pipeline_loss; grads come
     back in the same structure/sharding as params. ``scale`` is the fp16
     loss scale (defaults to 1.0, where grads are plain gradients).
+
+    ``gate_offstage``: cond-skip warmup/drain sub-ticks via tick-uniform
+    gates (default). False runs every sub-tick everywhere and
+    select-masks — only for measuring the gating win
+    (ablate_1f1b_gate.py).
     """
     M, Pstages = num_micro_batches, num_stages
     T = M + 2 * (Pstages - 1)
@@ -91,7 +114,22 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
             # the autodiff path's scaled-loss trick.
             return head_fn(sh, y, tgt, key).astype(jnp.float32) * scale / M
 
+        def ugate(pred, true_thunk, false_thunk):
+            # ``pred`` MUST be tick-uniform (a function of t, never of the
+            # rank): all devices take the same branch, so the collective
+            # sequence cannot diverge. Per-rank validity goes INSIDE the
+            # branch as selects.
+            if gate_offstage:
+                return lax.cond(pred, true_thunk, false_thunk)
+            out, zero = true_thunk(), false_thunk()
+            return jax.tree_util.tree_map(
+                lambda a, z: jnp.where(pred, a, z), out, zero)
+
         zeros_x = jnp.zeros(xshape, cdtype)
+        zeros_shared = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), shared)
+        zeros_blocks = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, p.dtype), blocks_local)
         carry0 = (
             zeros_x,                                  # fwd_buf
             zeros_x,                                  # bwd_buf (cotangent)
@@ -108,6 +146,19 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
         def tick(carry, t):
             fwd_buf, bwd_buf, ring, g_blocks, g_shared, loss_acc = carry
 
+            # Tick-uniform gate windows (functions of t only; see module
+            # docstring for why they must not depend on the rank):
+            #   embed fwd   stage 0's f = t            → t < M
+            #   stage fwd   some rank has 0 ≤ t-r < M  → t < M + last
+            #   head        last rank's h = t - last   → last ≤ t < M+last
+            #   stage bwd   some rank has valid b      → t ≥ last
+            #   embed bwd   rank 0's b = t - 2·last    → t ≥ 2·last
+            emb_t = t < M
+            fwd_t = t < M + last
+            head_t = jnp.logical_and(t >= last, t < M + last)
+            bwd_t = t >= last
+            embbwd_t = t >= 2 * last
+
             # ---------------- forward sub-tick ----------------
             f = t - r
             fc = jnp.clip(f, 0, M - 1)
@@ -115,27 +166,46 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
             key_f = mkey(fc)
             tok_f = lax.dynamic_index_in_dim(micro_tokens, fc, 0,
                                              keepdims=False)
-            x0 = embed_fn(shared, tok_f, key_f).astype(cdtype)
+            x0 = ugate(
+                emb_t,
+                lambda: embed_fn(shared, tok_f, key_f).astype(cdtype),
+                lambda: zeros_x)
             x_in = jnp.where(r == 0, x0, fwd_buf)
-            y = stage_fn(blocks_local, x_in, key_f)
+            y = ugate(
+                fwd_t,
+                lambda: stage_fn(blocks_local, x_in, key_f).astype(cdtype),
+                lambda: zeros_x)
             ring = lax.dynamic_update_index_in_dim(
                 ring, x_in, jnp.where(f_ok, fc % R, R), 0)
 
             # Head + its grad on the tick's own output (last stage: micro
-            # h == f). Uniform on all stages; masked elsewhere.
+            # h == f). The gate skips the whole vocab projection + vjp on
+            # the 2·last warmup/drain ticks; within the window, off-stage
+            # ranks still run it in parallel (latency-free) and the
+            # selects below discard their garbage.
             h = t - last
             hc = jnp.clip(h, 0, M - 1)
             tgt_h = lax.dynamic_index_in_dim(micro_targets, hc, 0,
                                              keepdims=False)
             key_h = jax.random.fold_in(rng, M + hc)
-            loss_h, (dsh_head, dy) = jax.value_and_grad(
-                head_loss, argnums=(0, 1))(shared, y, tgt_h, key_h)
             valid_h = jnp.logical_and(jnp.logical_and(h >= 0, h < M),
                                       r == last)
-            loss_acc = loss_acc + jnp.where(valid_h, loss_h, 0.0)
-            wh = jnp.where(valid_h, 1.0, 0.0)
+
+            def run_head():
+                l, (gsh, gy) = jax.value_and_grad(
+                    head_loss, argnums=(0, 1))(shared, y, tgt_h, key_h)
+                return (jnp.where(valid_h, l, 0.0),
+                        jax.tree_util.tree_map(
+                            lambda g: jnp.where(valid_h, g,
+                                                jnp.zeros_like(g)), gsh),
+                        jnp.where(valid_h, gy.astype(cdtype), zeros_x))
+
+            loss_h, dsh_head, dy = ugate(
+                head_t, run_head,
+                lambda: (jnp.zeros((), jnp.float32), zeros_shared, zeros_x))
+            loss_acc = loss_acc + loss_h
             g_shared = jax.tree_util.tree_map(
-                lambda a, g: a + wh * g, g_shared, dsh_head)
+                lambda a, g: a + g.astype(jnp.float32), g_shared, dsh_head)
 
             # ---------------- backward sub-tick ----------------
             b = t - 2 * last + r
@@ -144,27 +214,41 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
             key_b = mkey(bc)
             x_saved = lax.dynamic_index_in_dim(ring, bc % R, 0,
                                                keepdims=False)
-            g_in = jnp.where(r == last, dy.astype(cdtype), bwd_buf)
-            _, vjp = jax.vjp(
-                lambda bl, xi: stage_fn(bl, xi, key_b), blocks_local,
-                x_saved)
-            dblocks, dx = vjp(g_in)
-            wb = jnp.where(b_ok, 1.0, 0.0)
+            g_in = jnp.where(r == last, dy, bwd_buf)
+
+            def run_bwd():
+                _, vjp = jax.vjp(
+                    lambda bl, xi: stage_fn(bl, xi, key_b), blocks_local,
+                    x_saved)
+                dblocks, dx = vjp(g_in)
+                return (jax.tree_util.tree_map(
+                            lambda g: jnp.where(b_ok, g,
+                                                jnp.zeros_like(g)), dblocks),
+                        dx.astype(cdtype))
+
+            dblocks, dx = ugate(
+                bwd_t, run_bwd, lambda: (zeros_blocks, zeros_x))
             g_blocks = jax.tree_util.tree_map(
-                lambda a, g: a + wb * g.astype(jnp.float32),
-                g_blocks, dblocks)
+                lambda a, g: a + g.astype(jnp.float32), g_blocks, dblocks)
 
             # Embedding backward (tied front): stage 0 pulls its input
             # cotangent into the shared params.
             tok_b = lax.dynamic_index_in_dim(micro_tokens, bc, 0,
                                              keepdims=False)
-            _, evjp = jax.vjp(
-                lambda sh: embed_fn(sh, tok_b, key_b).astype(cdtype), shared)
-            (dsh_emb,) = evjp(dx)
-            we = jnp.where(jnp.logical_and(b_ok, r == 0), 1.0, 0.0)
+            valid_e = jnp.logical_and(b_ok, r == 0)
+
+            def run_embed_bwd():
+                _, evjp = jax.vjp(
+                    lambda sh: embed_fn(sh, tok_b, key_b).astype(cdtype),
+                    shared)
+                (dsh_emb,) = evjp(dx)
+                return jax.tree_util.tree_map(
+                    lambda g: jnp.where(valid_e, g, jnp.zeros_like(g)),
+                    dsh_emb)
+
+            dsh_emb = ugate(embbwd_t, run_embed_bwd, lambda: zeros_shared)
             g_shared = jax.tree_util.tree_map(
-                lambda a, g: a + we * g.astype(jnp.float32),
-                g_shared, dsh_emb)
+                lambda a, g: a + g.astype(jnp.float32), g_shared, dsh_emb)
 
             # ---------------- rotate (bf16 boundaries, as in spmd.py) ----
             fwd_next = lax.ppermute(

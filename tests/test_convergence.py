@@ -52,6 +52,8 @@ def second_half_loss(engine, cfg, batch):
     """Mean NLL on the copy half only — the capability metric."""
     from deepspeed_tpu.models.gpt2 import gpt2_apply
     params = jax.device_get(engine.state.params)
+    if "shared" in params and "blocks" in params:   # pipeline layout
+        params = {**params["shared"], "blocks": params["blocks"]}
     params = jax.tree_util.tree_map(jnp.asarray, params)
     tokens, targets = batch[:, :-1], batch[:, 1:]
     logits = gpt2_apply(params, jnp.asarray(tokens), cfg)
@@ -85,6 +87,54 @@ def zero2_config(lr=3e-3):
         "optimizer": {"type": "AdamW", "params": {"lr": lr}},
         "steps_per_print": 10 ** 9,
     }
+
+
+def train_pipe(ds_config, steps, seed=0, pp=2, dp=2):
+    """Same workload through the compiled SPMD pipeline (PipeSpec)."""
+    from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
+    cfg = model_cfg()
+    mesh = build_mesh(pp=pp, dp=dp, devices=jax.devices()[:pp * dp])
+    spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(seed))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=spec, config=ds_config, mesh=mesh)
+    batches = copy_batches(steps, ds_config["train_batch_size"], seed=seed)
+    losses = [float(engine.train_batch(jnp.asarray(b))) for b in batches]
+    return engine, cfg, losses, batches[0]
+
+
+def pipe_config(schedule, lr=3e-3):
+    # pp=2 x dp=2, M=4 micro-batches, ZeRO-1: the flagship 1F1B combo.
+    return {
+        "train_batch_size": 32,
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": 4,
+        "zero_optimization": {"stage": 1},
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW", "params": {"lr": lr}},
+        "pipeline": {"schedule": schedule},
+        "steps_per_print": 10 ** 9,
+    }
+
+
+@pytest.mark.slow
+def test_gpt2_learns_copy_task_1f1b_pipeline():
+    """The 1F1B interleaved pipeline (x ZeRO-1, pp=2 x dp=2) LEARNS the
+    copy task end-to-end — the reference's TrainSchedule is its default
+    train path (runtime/pipe/schedule.py:182-290); this is the TPU
+    equivalent proven at the capability level, not just grad parity."""
+    engine, cfg, losses, probe = train_pipe(pipe_config("1f1b"), steps=220)
+    assert losses[-1] < 2.6, f"final LM loss {losses[-1]} did not converge"
+    copy_nll = second_half_loss(engine, cfg, probe)
+    assert copy_nll < 0.9, f"copy-half NLL {copy_nll}: induction not learned"
+
+
+@pytest.mark.slow
+def test_convergence_1f1b_matches_gpipe_curve():
+    """Two schedules, one pipeline: identical loss curves (dropout off)."""
+    _, _, l_1f1b, _ = train_pipe(pipe_config("1f1b"), steps=50)
+    _, _, l_gpipe, _ = train_pipe(pipe_config("gpipe"), steps=50)
+    np.testing.assert_allclose(l_1f1b, l_gpipe, rtol=0.05, atol=0.05)
+    assert l_1f1b[-1] < l_1f1b[0] - 0.3
 
 
 @pytest.mark.slow
