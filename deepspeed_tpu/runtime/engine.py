@@ -183,7 +183,16 @@ class DeepSpeedEngine:
             self.compute_dtype = jnp.float16
         else:
             self.compute_dtype = jnp.float32
-        master_params = _cast_floats(init_params, jnp.float32)
+        # Master-free bf16 (bf16.stochastic_rounding): params live in bf16
+        # — no fp32 master copy at all, halving param-state HBM — and the
+        # optimizer apply rounds stochastically (unbiased), which is what
+        # keeps sub-ulp updates from being systematically dropped
+        # (reference stochastic_mode, ops/transformer/transformer.py:
+        # 39-151; ops/stochastic_rounding.py here).
+        self._master_free = bool(self.config.bf16_stochastic_rounding)
+        master_params = _cast_floats(
+            init_params,
+            jnp.bfloat16 if self._master_free else jnp.float32)
 
         # LR schedule: config scheduler (pure fn of step) or client scheduler.
         self.lr_scheduler = None
@@ -213,7 +222,15 @@ class DeepSpeedEngine:
         self._use_cast_cache = (
             self.compute_dtype != jnp.float32 and not self._onebit and
             not self.config.zero_config.cpu_offload and
-            not self.config.sparse_gradients_enabled)
+            not self.config.sparse_gradients_enabled and
+            not self._master_free)   # params already ARE the compute dtype
+        if self._master_free and (
+                self._onebit or self.config.zero_config.cpu_offload or
+                self.config.sparse_gradients_enabled):
+            raise ValueError(
+                "bf16.stochastic_rounding (master-free mode) composes with "
+                "the main train path only — onebit/offload/sparse_gradients "
+                "keep their own master-weight story")
         if self._onebit:
             if self.zero_optimization_stage() >= 1:
                 raise ValueError(
@@ -314,6 +331,14 @@ class DeepSpeedEngine:
                     lambda p: jnp.zeros((dp_,) + p.shape, jnp.float32),
                     params)
                 return st._replace(worker_error=werr)
+        elif self._master_free:
+            # bf16 params but f32 optimizer moments: init from an f32 view
+            # so Adam's accumulators don't inherit the bf16 storage dtype
+            # (updates then stay f32 end-to-end; only the final apply
+            # rounds, stochastically).
+            base_opt_init = self.tx.init
+            opt_init = lambda params: base_opt_init(
+                _cast_floats(params, jnp.float32))
         else:
             opt_init = self.tx.init
         opt_shape = () if opt_init is None \
@@ -784,6 +809,13 @@ class DeepSpeedEngine:
         if self._offload_grad_fn is None:
             self._offload_grad_fn = self._build_offload_grad_fn()
         off = self._offload
+        t_pre = _time.perf_counter()
+        # Fence the PREVIOUS step's async param H2D here, in its own
+        # bucket: without this, the upload time lands inside
+        # device_step_ms and the recorded breakdown cannot reconcile
+        # (round-4 OFFLOAD_BENCH.json's 80.5 s "device step" was ~3 GB of
+        # params crossing a 0.035 GB/s tunnel, not compute).
+        jax.block_until_ready(self.state.params)
         t0 = _time.perf_counter()
         grads, loss = self._offload_grad_fn(
             self.state.params, micro_batches, self._base_rng,
@@ -809,6 +841,7 @@ class DeepSpeedEngine:
         self.skipped_steps = off.skipped_steps
         metrics["loss"] = loss
         self.offload_timings = {
+            "h2d_wait_ms": (t0 - t_pre) * 1e3,
             "device_step_ms": (t1 - t0) * 1e3,
             "d2h_ms": (t2 - t1) * 1e3,
             "host_step_ms": (t3 - t2) * 1e3,
@@ -837,10 +870,6 @@ class DeepSpeedEngine:
             raise ValueError(
                 "sparse_gradients does not compose with OnebitAdam (the "
                 "compressed momentum exchange replaces the grad allreduce)")
-        if self.config.fp16_enabled:
-            raise NotImplementedError(
-                "sparse_gradients + fp16: the CSR exchange runs host-side, "
-                "outside the jitted loss-scale machinery; use bf16")
 
         def default(path, leaf):
             p = path.lower()
@@ -873,7 +902,9 @@ class DeepSpeedEngine:
         psum-averaged in-graph (ICI, where dense is the fast path); sparse
         embedding leaves come back per-rank [dp, V, H] for the host CSR
         exchange, whose wire volume is nnz_rows/vocab of dense (reference
-        engine.py:1197-1253)."""
+        engine.py:1197-1253). Under fp16 the loss is scale-multiplied so
+        grads come out SCALED (dense and sparse alike); the reported loss
+        is the raw mean."""
         shard_map = jax.shard_map
         gas = self._scan_microbatches()
         loss_fn = self.loss_fn
@@ -882,32 +913,38 @@ class DeepSpeedEngine:
         mask = self._sparse_mask
         pld, accepts_pld = self.progressive_layer_drop, self._accepts_pld
 
-        def per_rank(params, step, micro_batches, keys):
+        def per_rank(params, step, micro_batches, keys, scale):
             rank = lax.axis_index(DP_AXIS)
             keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
             theta = pld.theta_at(step.astype(jnp.float32)) \
                 if accepts_pld else None
 
             def mean_loss_fn(p):
-                def one_micro(loss_acc, xs):
+                def one_micro(carry, xs):
+                    scaled_acc, raw_acc = carry
                     mb, key = xs
                     cparams = _cast_floats(p, compute_dtype)
                     out = loss_fn(cparams, mb, key, pld_theta=theta) \
                         if accepts_pld else loss_fn(cparams, mb, key)
                     loss = out[0] if isinstance(out, tuple) else out
-                    return loss_acc + loss.astype(jnp.float32) / gas, None
+                    lf = loss.astype(jnp.float32)
+                    return (scaled_acc + lf * scale / gas,
+                            raw_acc + lf / gas), None
 
-                total, _ = lax.scan(one_micro, jnp.asarray(0.0, jnp.float32),
-                                    (micro_batches, keys))
-                return total
+                (scaled, raw), _ = lax.scan(
+                    one_micro, (jnp.asarray(0.0, jnp.float32),
+                                jnp.asarray(0.0, jnp.float32)),
+                    (micro_batches, keys))
+                return scaled, raw
 
-            loss_val, grads = jax.value_and_grad(mean_loss_fn)(params)
+            (_, loss_val), grads = jax.value_and_grad(
+                mean_loss_fn, has_aux=True)(params)
             grads = jax.tree_util.tree_map(
                 lambda g, m: g[None] if m else lax.psum(g, DP_AXIS) / dp,
                 grads, mask)
             return grads, lax.psum(loss_val, DP_AXIS) / dp
 
-        def grad_step(params, step, micro_batches, rng):
+        def grad_step(params, step, micro_batches, rng, scale):
             rng = jax.random.fold_in(rng, step)
             keys = jax.random.split(rng, gas)
             batch_specs = jax.tree_util.tree_map(
@@ -915,22 +952,41 @@ class DeepSpeedEngine:
             grad_specs = jax.tree_util.tree_map(
                 lambda m: P(DP_AXIS) if m else P(), mask)
             fn = shard_map(per_rank, mesh=mesh,
-                           in_specs=(P(), P(), batch_specs, P()),
+                           in_specs=(P(), P(), batch_specs, P(), P()),
                            out_specs=(grad_specs, P()),
                            check_vma=False)
-            return fn(params, step, micro_batches, keys)
+            return fn(params, step, micro_batches, keys, scale)
 
         return jax.jit(grad_step)
 
     def _build_sparse_apply_fn(self):
         """Optimizer apply on the CSR-combined (now dense, replicated)
         grads: global-norm clip + tx update, same semantics as the main
-        path's step."""
+        path's step. fp16: the sparse leaves arrive already unscaled (the
+        host-side exchange divides by the scale), so only the dense leaves
+        are unscaled here; the overflow vote spans BOTH (dense in-graph,
+        sparse via the host-computed flag), and overflow skips the step
+        and drives the dynamic scale machine exactly like the main path
+        (reference engine.py:1000-1085)."""
         tx = self.tx
         clip = self.gradient_clipping()
         schedule_fn = self._schedule_fn
+        fp16 = self.config.fp16_enabled
+        static_scale = self._static_loss_scale
+        scale_window = self._scale_window
+        min_scale = self._min_scale
+        hysteresis_init = self._hysteresis
+        mask = self._sparse_mask
 
-        def apply_step(state, grads):
+        def apply_step(state, grads, sparse_overflow):
+            if fp16:
+                inv = 1.0 / state.loss_scale
+                grads = jax.tree_util.tree_map(
+                    lambda g, m: g if m else g * inv, grads, mask)
+                overflow = jnp.logical_or(sparse_overflow,
+                                          tree_has_inf_or_nan(grads))
+            else:
+                overflow = jnp.asarray(False)
             grad_norm = global_norm(grads)
             if clip and clip > 0:
                 coeff = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
@@ -938,23 +994,48 @@ class DeepSpeedEngine:
             updates, new_opt = tx.update(grads, state.opt_state, state.params)
             import optax
             new_params = optax.apply_updates(state.params, updates)
-            new_state = state.replace(step=state.step + 1,
-                                      params=new_params, opt_state=new_opt)
-            return new_state, grad_norm, schedule_fn(state.step)
+            keep = overflow
+            new_params = _tree_select(keep, state.params, new_params)
+            new_opt = _tree_select(keep, state.opt_state, new_opt)
+            if fp16 and not static_scale:
+                ls = LossScaleState(
+                    loss_scale=state.loss_scale,
+                    growth_count=state.growth_count,
+                    hysteresis=state.hysteresis, dynamic=True,
+                    scale_window=scale_window, min_scale=min_scale,
+                    hysteresis_init=hysteresis_init, scale_factor=2.0)
+                ls = update_loss_scale(ls, overflow)
+                new_scale, new_growth, new_hyst = (
+                    ls.loss_scale, ls.growth_count, ls.hysteresis)
+            else:
+                new_scale, new_growth, new_hyst = (
+                    state.loss_scale, state.growth_count, state.hysteresis)
+            new_state = state.replace(
+                step=state.step + jnp.where(keep, 0, 1).astype(jnp.int32),
+                params=new_params, opt_state=new_opt,
+                loss_scale=new_scale, growth_count=new_growth,
+                hysteresis=new_hyst,
+                skipped_steps=state.skipped_steps +
+                jnp.where(keep, 1, 0).astype(jnp.int32))
+            return new_state, grad_norm, schedule_fn(state.step), overflow
 
         return jax.jit(apply_step, donate_argnums=(0,))
 
-    def _csr_exchange(self, grads):
+    def _csr_exchange(self, grads, inv_scale: float = 1.0):
         """Replace each sparse leaf's stacked per-rank grads [dp, V, H]
         with the CSR-allreduced dense mean. Mirrors the reference's
         csr_allreduce (engine.py:1212-1253): extract nonzero rows, gather
         values+indices across ranks (padded allgather across hosts),
-        coalesce, densify. Returns (grads, shipped_elems, dense_elems)."""
+        coalesce, densify. fp16: the gathered CSR values are unscaled
+        HERE (``inv_scale``, nnz elements touched instead of V*H) and
+        vetted for inf/NaN — the host half of the overflow vote. Returns
+        (grads, shipped_elems, dense_elems, sparse_overflow)."""
         from .csr_tensor import CSRTensor, all_gather_csr
         procs = jax.process_count()
         repl = NamedSharding(self.mesh, P())
         shipped = [0]
         dense_n = [0]
+        overflow = [False]
 
         def combine(g, m):
             if not m:
@@ -975,6 +1056,12 @@ class DeepSpeedEngine:
             local = all_gather_csr(csr_shards)
             if procs > 1:
                 local = comm.csr_exchange_hosts(local)
+            if not np.all(np.isfinite(local.values)):
+                overflow[0] = True
+            if inv_scale != 1.0:
+                local = CSRTensor(local.row_indices,
+                                  local.values * inv_scale,
+                                  local.dense_shape)
             dense = (local.to_dense() / self.dp_size).astype(np.float32)
             dense_n[0] += local.dense_size
             if procs > 1:
@@ -982,22 +1069,26 @@ class DeepSpeedEngine:
             return jax.device_put(dense, repl)
 
         new_grads = jax.tree_util.tree_map(combine, grads, self._sparse_mask)
-        return new_grads, shipped[0], dense_n[0]
+        return new_grads, shipped[0], dense_n[0], overflow[0]
 
     def _train_batch_sparse(self, micro_batches):
         if self._sparse_grad_fn is None:
             self._sparse_grad_fn = self._build_sparse_grad_fn()
             self._sparse_apply_fn = self._build_sparse_apply_fn()
+        scale = self.state.loss_scale
         grads, loss = self._sparse_grad_fn(
             self.state.params, jnp.asarray(self.global_steps, jnp.int32),
-            micro_batches, self._base_rng)
-        grads, shipped, dense_n = self._csr_exchange(grads)
+            micro_batches, self._base_rng, scale)
+        inv = 1.0 / float(jax.device_get(scale)) \
+            if self.config.fp16_enabled else 1.0
+        grads, shipped, dense_n, sp_overflow = self._csr_exchange(
+            grads, inv_scale=inv)
         self.sparse_comm_stats = {"sparse_elements": int(shipped),
                                   "dense_elements": int(dense_n)}
-        self.state, grad_norm, lr = self._sparse_apply_fn(self.state, grads)
+        self.state, grad_norm, lr, overflow = self._sparse_apply_fn(
+            self.state, grads, jnp.asarray(sp_overflow))
         return {"loss": loss, "grad_norm": grad_norm, "lr": lr,
-                "loss_scale": jnp.asarray(1.0),
-                "overflow": jnp.asarray(False)}
+                "loss_scale": scale, "overflow": overflow}
 
     # ------------------------------------------------------------------ #
     # The jitted train step
@@ -1166,6 +1257,7 @@ class DeepSpeedEngine:
         pld = self.progressive_layer_drop
         accepts_pld = self._accepts_pld
         use_cache = self._use_cast_cache
+        master_free = self._master_free
 
         def scaled_loss(params, mb, key, scale, theta):
             # With the cast cache, ``params`` arrive already in the compute
@@ -1257,7 +1349,20 @@ class DeepSpeedEngine:
 
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
             import optax
-            new_params = optax.apply_updates(state.params, updates)
+            if master_free:
+                # Master-free bf16: the f32 update lands on the bf16 param
+                # via unbiased stochastic rounding — sub-ulp updates
+                # survive in expectation instead of being dropped by
+                # round-to-nearest (ops/stochastic_rounding.py).
+                from ..ops.stochastic_rounding import \
+                    tree_stochastic_round_bf16
+                summed = jax.tree_util.tree_map(
+                    lambda p, u: p.astype(jnp.float32) + u,
+                    state.params, updates)
+                new_params = tree_stochastic_round_bf16(
+                    summed, jax.random.fold_in(rng, 0x5352))
+            else:
+                new_params = optax.apply_updates(state.params, updates)
             # Refresh the compute-dtype cache in the same fused pass as the
             # param update (one extra compute-dtype write instead of next
             # step's full fp32 re-read + cast).

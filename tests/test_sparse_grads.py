@@ -119,10 +119,6 @@ def test_sparse_gradients_gates():
         DeepSpeedEngine(model=loss_fn, model_params=model_params(),
                         config=_cfg(True, zero_optimization={"stage": 1}),
                         mesh=mesh)
-    with pytest.raises(NotImplementedError):
-        DeepSpeedEngine(model=loss_fn, model_params=model_params(),
-                        config=_cfg(True, fp16={"enabled": True}),
-                        mesh=mesh)
     with pytest.raises(ValueError):
         DeepSpeedEngine(
             model=loss_fn, model_params=model_params(),
@@ -139,6 +135,46 @@ def test_sparse_custom_filter():
     assert eng._sparse_names == ["['out_w']"] or "out_w" in eng._sparse_names[0]
     loss = float(jax.device_get(eng.train_batch(make_batch(0))))
     assert np.isfinite(loss)
+
+
+def test_sparse_fp16_parity_with_dense_fp16():
+    """fp16 x sparse_gradients (reference runs its CSR allreduce in its
+    default fp16 world, engine.py:1197-1253): the host exchange unscales
+    the CSR values and the apply step unscales the dense leaves — N steps
+    of the fp16 CSR path == N steps of the fp16 dense path."""
+    mesh = build_mesh()
+    fp16 = {"enabled": True, "loss_scale": 1024}
+    eng_s = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                            config=_cfg(True, fp16=fp16), mesh=mesh)
+    eng_d = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                            config=_cfg(False, fp16=fp16), mesh=mesh)
+    for i in range(5):
+        b = make_batch(i)
+        ls = float(jax.device_get(eng_s.train_batch(b)))
+        ld = float(jax.device_get(eng_d.train_batch(b)))
+        np.testing.assert_allclose(ls, ld, rtol=5e-3, atol=5e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(
+                        jax.device_get(eng_s.state.params)),
+                    jax.tree_util.tree_leaves(
+                        jax.device_get(eng_d.state.params))):
+        np.testing.assert_allclose(a, b, rtol=2e-3, atol=2e-5)
+
+
+def test_sparse_fp16_overflow_votes_and_skips():
+    """A loss scale far beyond fp16 range produces inf in the backward;
+    the overflow vote must include the sparse (host-exchanged) leaves and
+    the step must be skipped with params untouched."""
+    eng = DeepSpeedEngine(
+        model=loss_fn, model_params=model_params(),
+        config=_cfg(True, fp16={"enabled": True, "loss_scale": 2 ** 32}),
+        mesh=build_mesh())
+    p0 = jax.device_get(eng.state.params)
+    eng.train_batch(make_batch(0))
+    p1 = jax.device_get(eng.state.params)
+    for a, b in zip(jax.tree_util.tree_leaves(p0),
+                    jax.tree_util.tree_leaves(p1)):
+        np.testing.assert_array_equal(a, b)
+    assert int(jax.device_get(eng.state.skipped_steps)) == 1
 
 
 def test_sparse_trains_to_convergence():
