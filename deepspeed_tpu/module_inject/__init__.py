@@ -13,8 +13,13 @@ HuggingFace *Flax* parameter tree into the stacked block-parameter layout of
 from .replace import (bert_config_from_hf, extract_bert_encoder,
                       gpt2_config_from_hf, extract_gpt2_blocks,
                       restore_bert_encoder, restore_gpt2_blocks)
+from .policy import (InjectionPolicy, detect_policy, get_policy,
+                     register_policy, registered_policies, replace_module,
+                     replace_subtrees)
 
 __all__ = [
     "bert_config_from_hf", "extract_bert_encoder", "restore_bert_encoder",
     "gpt2_config_from_hf", "extract_gpt2_blocks", "restore_gpt2_blocks",
+    "InjectionPolicy", "register_policy", "get_policy", "detect_policy",
+    "registered_policies", "replace_module", "replace_subtrees",
 ]

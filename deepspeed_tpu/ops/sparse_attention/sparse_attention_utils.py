@@ -99,9 +99,13 @@ class SparseAttentionUtils:
         sparsity block size — use ``pad_to_block_size``.
         """
         from ...models.transformer import apply_blocks
-        from ...module_inject.replace import (bert_config_from_hf,
-                                              extract_bert_encoder)
-        cfg = bert_config_from_hf(hf_config)
+        from ...module_inject.policy import detect_policy
+        # Architecture dispatch through the injection-policy registry
+        # (reference :96-107 dispatches on BertModel/RobertaModel types;
+        # here any registered encoder policy — bert, roberta, or a
+        # user-registered one — resolves the weight mapping).
+        pol = detect_policy(hf_config)
+        cfg = pol.config_from_hf(hf_config)
         if sparsity_config is None:
             sparsity_config = FixedSparsityConfig(num_heads=cfg.num_heads)
         if sparsity_config.num_heads != cfg.num_heads:
@@ -111,7 +115,7 @@ class SparseAttentionUtils:
         if max_position is not None:
             import dataclasses
             cfg = dataclasses.replace(cfg, max_seq_length=max_position)
-        stacked = extract_bert_encoder(hf_params)
+        stacked = pol.extract(hf_params)
         ssa = SparseSelfAttention(sparsity_config)
 
         def attention_fn(q, k, v, mask=None, causal=False, attn_dropout=0.0,
