@@ -71,13 +71,15 @@ class PipelineModule:
                  topology=None, loss_fn: Optional[Callable] = None,
                  seed_layers: bool = False, base_seed: int = 1234,
                  partition_method: str = "parameters",
-                 activation_checkpoint_interval: int = 0):
+                 activation_checkpoint_interval: int = 0,
+                 profile_input: Any = None):
         self._layer_specs = list(layers)
         self.loss_fn = loss_fn
         self.seed_layers = seed_layers
         self.base_seed = base_seed
         self.partition_method = partition_method
         self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.profile_input = profile_input
         self._topo = topology
 
         if topology is not None:
@@ -150,11 +152,57 @@ class PipelineModule:
             weights = [w if w > 0 else 1e-6 for w in weights]
             parts = partition_balanced(weights, self.num_stages)
         elif method == "profile":
-            raise NotImplementedError("profile partitioning arrives with the "
-                                      "runtime profiler")
+            # The reference never implemented this (its module.py:374-375
+            # raises); on TPU it falls out of XLA's analytic cost model —
+            # no timed microruns, no device needed, deterministic.
+            if self.profile_input is None:
+                raise ValueError(
+                    'partition_method="profile" needs a sample input: '
+                    "PipelineModule(..., profile_input=batch_x) so each "
+                    "layer can be lowered through XLA's cost model")
+            parts = partition_balanced(
+                self._profile_layer_costs(self.profile_input),
+                self.num_stages)
         else:
             raise KeyError(f"unknown partition method {self.partition_method}")
         return parts
+
+    def _profile_layer_costs(self, sample_input) -> List[float]:
+        """Per-layer cost from XLA's analytic cost model: each layer is
+        jit-lowered at the activation shape that actually reaches it (the
+        sample flows layer to layer) and its compiled FLOPs are the
+        balance weight. Backward cost is proportional to forward for the
+        layer types a pipeline scans, so forward FLOPs rank stages the
+        same way measured step times would — without timing noise."""
+        import jax.numpy as jnp
+        x = jnp.asarray(sample_input)
+        rng = jax.random.PRNGKey(self.base_seed)
+        costs: List[float] = []
+        for i, layer in enumerate(self.layers):
+            lrng = self.layer_rng(i, rng)
+            if hasattr(layer, "init") and hasattr(layer, "apply"):
+                p = layer.init(lrng, x)
+                fn = (lambda layer, p, lrng: lambda xx: layer.apply(
+                    p, xx, rngs={"dropout": lrng}))(layer, p, lrng)
+            elif callable(layer):
+                fn = layer
+            else:
+                raise TypeError(f"layer {i} ({type(layer)}) is not callable")
+            flops = 1.0
+            try:
+                compiled = jax.jit(fn).lower(x).compile()
+                ca = compiled.cost_analysis()
+                if isinstance(ca, (list, tuple)):
+                    ca = ca[0] if ca else {}
+                flops = float((ca or {}).get("flops", 0.0))
+            except Exception as e:  # non-jittable layer: fall back flat
+                logger.warning(f"profile partitioning: layer {i} could not "
+                               f"be lowered ({e}); weighting it 1.0")
+            costs.append(max(flops, 1.0))
+            x = fn(x)
+        logger.info(f"profile partition costs (MFLOPs/layer): "
+                    f"{[round(c / 1e6, 3) for c in costs]}")
+        return costs
 
     def stage_layers(self, stage_id: int) -> List[Any]:
         lo, hi = self.parts[stage_id], self.parts[stage_id + 1]

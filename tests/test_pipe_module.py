@@ -177,3 +177,50 @@ class TestToPipeSpec:
         params = {f"layer_{i}": {"w": jnp.eye(4)} for i in range(2)}
         with pytest.raises(ValueError, match="uniform stages"):
             module.to_pipe_spec(params)
+
+
+class TestProfilePartitioning:
+    """partition_method='profile': XLA cost-model-driven cuts. The
+    reference never implemented this (module.py:374-375 raises); here a
+    FLOPs-skewed model must get non-uniform cuts that beat uniform."""
+
+    @staticmethod
+    def _skewed_layers():
+        def make(width, seed):
+            a = jax.random.normal(jax.random.PRNGKey(seed), (64, width)) * .1
+            b = jax.random.normal(jax.random.PRNGKey(seed + 1),
+                                  (width, 64)) * .1
+            return lambda x: jnp.tanh(x @ a) @ b
+        # Two heavy layers up front, six light ones behind.
+        return [make(1024, 2 * i) for i in range(2)] + \
+               [make(8, 100 + 2 * i) for i in range(6)]
+
+    def test_requires_sample_input(self):
+        with pytest.raises(ValueError):
+            PipelineModule(self._skewed_layers(), num_stages=2,
+                           partition_method="profile")
+
+    def test_skewed_model_beats_uniform(self):
+        layers = self._skewed_layers()
+        x = jnp.ones((4, 64), jnp.float32)
+        m = PipelineModule(layers, num_stages=2, partition_method="profile",
+                           profile_input=x)
+        mu = PipelineModule(layers, num_stages=2, partition_method="uniform")
+        assert mu.parts == [0, 4, 8]
+        # Profile must cut earlier than uniform: the two heavy layers
+        # dominate, so stage 0 ends at or before layer 2.
+        assert m.parts[1] <= 2, m.parts
+        costs = m._profile_layer_costs(x)
+
+        def stage_max(parts):
+            return max(sum(costs[parts[s]:parts[s + 1]])
+                       for s in range(len(parts) - 1))
+        assert stage_max(m.parts) < stage_max(mu.parts)
+
+    def test_profile_flax_layers(self):
+        layers = [Dense(64, 64) for _ in range(4)]
+        x = jnp.ones((4, 64), jnp.float32)
+        m = PipelineModule(layers, num_stages=2, partition_method="profile",
+                           profile_input=x)
+        # Equal-cost layers: profile degrades to the uniform cut.
+        assert m.parts == [0, 2, 4]
