@@ -86,6 +86,11 @@ BF16 = "bf16"
 BF16_ENABLED = "enabled"
 # TPU-native default: bf16 on unless a parity config says otherwise.
 BF16_ENABLED_DEFAULT = False
+# Master-free bf16: params live in bf16 and the optimizer apply rounds
+# stochastically (the reference transformer kernel's stochastic_mode,
+# ops/transformer/transformer.py:39-151, re-done as a TPU bit trick).
+BF16_STOCHASTIC_ROUNDING = "stochastic_rounding"
+BF16_STOCHASTIC_ROUNDING_DEFAULT = False
 
 PRECISION_DEFAULT = "fp32"
 

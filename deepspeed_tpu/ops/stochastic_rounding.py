@@ -1,0 +1,51 @@
+"""TPU-native stochastic rounding for master-free bf16 training.
+
+Parity target: the reference transformer kernel's ``stochastic_mode``
+(ops/transformer/transformer.py:39-151), which trades a little per-step
+rounding noise for running without fp32 master weights. The CUDA kernels
+implement it inside fused elementwise updates; on TPU it is a two-op bit
+trick XLA fuses into the optimizer apply.
+
+Why it works: a bf16 value is the top 16 bits of an f32. Truncating an f32
+to bf16 always rounds toward zero magnitude; ADDING a uniform random
+16-bit integer to the f32's low mantissa bits before truncation makes the
+carry into bit 16 fire with probability exactly equal to the fractional
+distance to the next representable bf16 — i.e. unbiased stochastic
+rounding: E[round(x)] == x. Round-to-nearest instead loses every update
+smaller than half a ulp, which is how bf16 master-free SGD stalls; the
+unbiasedness is what lets hundreds of tiny updates accumulate correctly
+(the same argument the reference makes for fp16 stochastic mode).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """Round f32 ``x`` to bf16 stochastically (unbiased). ``key`` is a
+    PRNG key; every call site must fold a distinct key per step/leaf."""
+    x = x.astype(jnp.float32)
+    bits = lax.bitcast_convert_type(x, jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint32) & jnp.uint32(0xFFFF)
+    rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+    out = lax.bitcast_convert_type(rounded, jnp.float32).astype(jnp.bfloat16)
+    # inf/nan must stay put (the carry could walk an inf into nan space);
+    # overflow handling belongs to the loss-scale machinery, not here.
+    return jnp.where(jnp.isfinite(x), out, x.astype(jnp.bfloat16))
+
+
+def tree_stochastic_round_bf16(tree, key: jax.Array):
+    """Apply ``stochastic_round_bf16`` to every float leaf with a distinct
+    per-leaf key; non-float leaves pass through."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            out.append(stochastic_round_bf16(leaf,
+                                             jax.random.fold_in(key, i)))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -208,6 +208,9 @@ class DeepSpeedConfig:
 
         bf16 = d.get(C.BF16, {})
         self.bf16_enabled = get(bf16, C.BF16_ENABLED, C.BF16_ENABLED_DEFAULT)
+        self.bf16_stochastic_rounding = get(
+            bf16, C.BF16_STOCHASTIC_ROUNDING,
+            C.BF16_STOCHASTIC_ROUNDING_DEFAULT)
 
         amp = d.get(C.AMP, {})
         self.amp_enabled = get(amp, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
@@ -306,6 +309,10 @@ class DeepSpeedConfig:
         self._batch_assertion()
         if self.fp16_enabled and self.bf16_enabled:
             raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        if self.bf16_stochastic_rounding and not self.bf16_enabled:
+            raise DeepSpeedConfigError(
+                "bf16.stochastic_rounding requires bf16.enabled (it is the "
+                "master-free bf16 update mode)")
         if self.zero_enabled and self.zero_optimization_stage > C.MAX_STAGE_ZERO_OPTIMIZATION:
             raise DeepSpeedConfigError(
                 f"ZeRO stage {self.zero_optimization_stage} > max "

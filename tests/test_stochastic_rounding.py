@@ -1,0 +1,155 @@
+"""Stochastic rounding: the master-free bf16 mode (reference
+``stochastic_mode``, ops/transformer/transformer.py:39-151, re-done as the
+TPU add-noise-and-truncate bit trick in ops/stochastic_rounding.py).
+
+Tier 1: the rounding primitive is unbiased and lands only on the two
+neighboring bf16 values. Tier 2: an engine in master-free mode follows the
+fp32-master engine's loss curve over a few hundred steps — while
+round-to-nearest master-free updates visibly stall (the failure mode the
+mode exists to avoid).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.stochastic_rounding import (stochastic_round_bf16,
+                                                   tree_stochastic_round_bf16)
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import build_mesh
+
+
+class TestPrimitive:
+    def test_lands_on_bf16_neighbors(self):
+        x = jnp.float32(1.0 + 1 / 512)   # strictly between bf16(1.0), next
+        lo = jnp.bfloat16(1.0)
+        hi = (lo.astype(jnp.float32) + 1 / 128).astype(jnp.bfloat16)
+        keys = jax.random.split(jax.random.PRNGKey(0), 256)
+        vals = {float(stochastic_round_bf16(x, k)) for k in keys}
+        assert vals <= {float(lo), float(hi)}
+        assert len(vals) == 2            # both neighbors occur
+
+    def test_unbiased(self):
+        # x sits 1/4 of the way from 1.0 to the next bf16 (step 1/128):
+        # the high neighbor must be drawn with p ~= 0.25.
+        x = jnp.float32(1.0 + 1 / 512)
+        keys = jax.random.split(jax.random.PRNGKey(1), 4096)
+        draws = jax.vmap(lambda k: stochastic_round_bf16(x, k))(keys)
+        mean = float(jnp.mean(draws.astype(jnp.float32)))
+        np.testing.assert_allclose(mean, float(x), rtol=2e-4)
+
+    def test_exact_values_fixed(self):
+        # Representable values never move, whatever the key.
+        for v in (0.0, 1.0, -3.5, 256.0):
+            x = jnp.bfloat16(v).astype(jnp.float32)
+            out = stochastic_round_bf16(x, jax.random.PRNGKey(7))
+            assert float(out) == float(x)
+
+    def test_nonfinite_passthrough(self):
+        x = jnp.asarray([jnp.inf, -jnp.inf, jnp.nan], jnp.float32)
+        out = stochastic_round_bf16(x, jax.random.PRNGKey(3))
+        assert np.isposinf(float(out[0])) and np.isneginf(float(out[1]))
+        assert np.isnan(float(out[2]))
+
+    def test_tree_variant_distinct_keys(self):
+        t = {"a": jnp.full((64,), 1.0 + 1 / 512, jnp.float32),
+             "b": jnp.full((64,), 1.0 + 1 / 512, jnp.float32)}
+        out = tree_stochastic_round_bf16(t, jax.random.PRNGKey(0))
+        assert not np.array_equal(np.asarray(out["a"], np.float32),
+                                  np.asarray(out["b"], np.float32))
+
+
+# ------------------------------------------------------------------ #
+# Engine tier
+# ------------------------------------------------------------------ #
+DIM = 32
+_W_TRUE = np.random.default_rng(0).standard_normal(DIM).astype(np.float32)
+
+
+def loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_batch(i, n=64):
+    r = np.random.default_rng(i)
+    x = r.standard_normal((n, DIM)).astype(np.float32)
+    y = x @ _W_TRUE
+    return {"x": jnp.asarray(x), "y": jnp.asarray(y)}
+
+
+def _params():
+    return {"w": jnp.zeros((DIM,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def _cfg(**bf16):
+    return {
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 64,
+        "gradient_accumulation_steps": 1,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "bf16": dict({"enabled": True}, **bf16),
+        "steps_per_print": 10 ** 9,
+    }
+
+
+def _run(cfg, steps=300):
+    eng = DeepSpeedEngine(model=loss_fn, model_params=_params(),
+                          config=cfg, mesh=build_mesh(devices=jax.devices()[:1]))
+    return eng, [float(jax.device_get(eng.train_batch(make_batch(i))))
+                 for i in range(steps)]
+
+
+def test_config_gate():
+    from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                              DeepSpeedConfigError)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 4,
+                         "train_micro_batch_size_per_gpu": 4,
+                         "gradient_accumulation_steps": 1,
+                         "bf16": {"enabled": False,
+                                  "stochastic_rounding": True}},
+                        world_size=1)
+
+
+@pytest.mark.slow
+def test_master_free_matches_fp32_masters():
+    """Loss parity over a few hundred steps: bf16 params + stochastic
+    rounding tracks the fp32-master curve."""
+    eng_sr, l_sr = _run(_cfg(stochastic_rounding=True))
+    eng_ms, l_ms = _run(_cfg())
+    # master-free state really is bf16 (no fp32 copy anywhere)
+    assert eng_sr.state.params["w"].dtype == jnp.bfloat16
+    assert eng_ms.state.params["w"].dtype == jnp.float32
+    # late-training averages agree (per-step curves are noisy in bf16)
+    tail_sr = float(np.mean(l_sr[-50:]))
+    tail_ms = float(np.mean(l_ms[-50:]))
+    assert tail_sr < 0.05 * l_sr[0], (l_sr[0], tail_sr)
+    np.testing.assert_allclose(tail_sr, tail_ms, atol=0.02, rtol=0.5)
+
+
+@pytest.mark.slow
+def test_stochastic_beats_nearest_rounding():
+    """The reason the mode exists: with lr small enough that updates drop
+    below half a bf16 ulp, round-to-nearest master-free training stalls
+    while stochastic rounding keeps making progress."""
+    lr = 3e-4
+    w0 = jnp.full((DIM,), 0.5, jnp.bfloat16)
+
+    def run(round_fn, steps=600):
+        w = w0
+        m = jax.jit(lambda w, x, y: jax.grad(
+            lambda w: jnp.mean((x @ w - y) ** 2))(w.astype(jnp.float32)))
+        key = jax.random.PRNGKey(0)
+        for i in range(steps):
+            b = make_batch(i)
+            g = m(w, b["x"], b["y"])
+            key, k = jax.random.split(key)
+            w = round_fn(w.astype(jnp.float32) - lr * g, k)
+        b = make_batch(10 ** 6)
+        return float(jnp.mean((b["x"] @ w.astype(jnp.float32) - b["y"]) ** 2))
+
+    loss_sr = run(stochastic_round_bf16)
+    loss_rn = run(lambda x, k: x.astype(jnp.bfloat16))
+    assert loss_sr < loss_rn * 0.9, (loss_sr, loss_rn)
