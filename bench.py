@@ -117,15 +117,50 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
     dt = (time.perf_counter() - t0) / n_steps
     tokens_per_sec = micro_bs * gas * S / dt
     tflops = tokens_per_sec * gpt2_flops_per_token(cfg, S) / 1e12
-    t = engine.offload_timings or {}
+    t = dict(engine.offload_timings or {})
+    comp_sum_ms = sum(t.values())
+
+    # Device-only step: params are resident and no H2D is pending after the
+    # timed loop, so a bare grads pass fenced by the loss fetch is pure
+    # compute — the number the round-4 record could not support.
+    micro = engine._stack_micro_batches(batch)
+    # Fence the last step's async param upload — without this the grad
+    # pass blocks on the in-flight H2D and "device only" absorbs it.
+    jax.block_until_ready(engine.state.params)
+    t_dev = time.perf_counter()
+    _, loss = engine._offload_grad_fn(
+        engine.state.params, micro, engine._base_rng,
+        jnp.asarray(engine.global_steps, jnp.int32),
+        jnp.asarray(engine._offload.loss_scale, jnp.float32))
+    _ = float(jax.device_get(loss))
+    device_only_ms = (time.perf_counter() - t_dev) * 1e3
+
+    # Transfer byte accounting (what the tunnel moves each step): bf16
+    # grads down, bf16 params up.
+    grad_bytes = sum(int(np.prod(l.shape)) * 2 for l in
+                     jax.tree_util.tree_leaves(engine.state.params))
+    # Projection to a real TPU-VM host (local PCIe/DMA, not the dev
+    # tunnel): same measured device compute + host Adam, transfers at the
+    # stated bandwidth. TPU-VM hosts measure >10 GB/s; 10 is conservative.
+    vm_gbs = 10.0
+    xfer_ms = 2 * grad_bytes / (vm_gbs * 1e9) * 1e3      # D2H + H2D
+    proj_ms = device_only_ms + xfer_ms + t.get("host_step_ms", 0.0)
+    proj_tps = micro_bs * gas * S / (proj_ms / 1e3)
     return {
         "offload_model": f"gpt2-xl({n_params/1e9:.2f}B)",
         "offload_grad_accum_steps": gas,
         "offload_tokens_per_sec": round(tokens_per_sec, 1),
         "offload_tflops_per_chip": round(tflops, 2),
-        "offload_device_step_ms": round(t.get("device_step_ms", -1), 1),
-        "offload_d2h_ms": round(t.get("d2h_ms", -1), 1),
-        "offload_host_adam_ms": round(t.get("host_step_ms", -1), 1),
+        "offload_step_wall_ms": round(dt * 1e3, 1),
+        "offload_components_ms": {k: round(v, 1) for k, v in t.items()},
+        "offload_components_sum_ms": round(comp_sum_ms, 1),
+        "offload_device_only_step_ms": round(device_only_ms, 1),
+        "offload_transfer_bytes_each_way": grad_bytes,
+        "projected_tpu_vm": {
+            "assumed_host_link_gb_s": vm_gbs,
+            "step_ms": round(proj_ms, 1),
+            "tokens_per_sec": round(proj_tps, 1),
+        },
     }
 
 

@@ -58,7 +58,11 @@ def _infer_node_rank(world: dict) -> int:
     if wid is not None and wid.isdigit():
         from .constants import pod_index_of
         tails = [pod_index_of(h) for h in hosts]
-        if all(t is not None for t in tails):
+        # Uniqueness condition must match GcloudTPURunner.worker_indices
+        # (multinode_runner.py): with duplicate tails (e.g. 'a-1', 'b-1')
+        # the dispatcher falls back to POSITIONAL worker indices, so the
+        # worker must rank itself positionally too or ranks misalign.
+        if all(t is not None for t in tails) and len(set(tails)) == len(tails):
             # Digit-tailed world: the tails ARE the pod indices; a wid
             # outside them means this worker was filtered out of the
             # launch — positional fallback would duplicate a rank.
