@@ -129,8 +129,10 @@ class PipelineEngine(DeepSpeedEngine):
         pp = int(self.mesh.shape.get("pipe", 1))
         if pp > 1:
             raise NotImplementedError(
-                "pp>1 needs uniform stages: express the model as a PipeSpec "
-                "(models/gpt2_pipe.py) for the compiled SPMD pipeline")
+                "pp>1 needs an SPMD-expressible model: express uniform "
+                "stages as a PipeSpec (models/gpt2_pipe.py), or stages "
+                "with DIFFERENT programs (e.g. conv stem + transformer "
+                "body) via hetero_pipe_spec (runtime/pipe/hetero.py)")
         log_dist(self.pipeline_module.describe(), ranks=[0])
 
     @staticmethod

@@ -1,0 +1,124 @@
+"""Heterogeneous per-stage pipeline programs.
+
+Parity target: the reference partitions ANY layer list across stages —
+e.g. a conv stem on stage 0 feeding transformer stages (reference
+runtime/pipe/module.py:348-404 builds each rank's own layer sublist).
+An SPMD pipeline cannot do that literally: shard_map traces ONE stage
+program for every pipe rank.
+
+Design: run-all-and-select. Each tick every rank executes EVERY program
+on its input and ``lax.select_n`` keeps the one its stage owns. This is
+deliberately NOT a per-rank ``lax.switch``: a rank-dependent branch
+around program bodies puts the partitioner-inserted dp/mp collectives on
+some ranks' execution paths and not others', which deadlocks the
+collective rendezvous — the same failure the 1F1B tick gates had to
+design around (see spmd_1f1b.py's module docstring). ``select_n``
+executes all branches uniformly, so any program may contain sharded
+matmuls/collectives.
+
+Cost model (why this is acceptable, and when it is not): per tick every
+rank pays SUM of program costs instead of its own program's cost. With
+K programs the waste factor is at most K; for the intended shape — one
+cheap stem/adapter program plus one dominant block program — the waste
+is the stem's cost, a few percent. For K heavyweight programs an SPMD
+pipeline is the wrong tool; split the model into two meshes instead.
+Param memory: each program's params are stacked over ALL P stages (zeros
+on stages that don't own the program) so each rank stores one stage
+slice of every program — overhead (K-1) stage slices, not K full models.
+
+Gradient correctness falls out of autodiff: ``select_n``'s vjp routes
+the cotangent only to the selected branch, so the zero-padded slices of
+unowned programs receive exactly-zero grads and the optimizer leaves
+them at zero. Works under both the GPipe schedule (autodiff) and the
+1F1B manual-vjp schedule, since both consume only the stage_fn contract.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ...parallel.topology import PP_AXIS
+
+
+def hetero_pipe_spec(embed_fn: Callable, head_fn: Callable,
+                     programs: Sequence[Callable],
+                     stage_programs: Sequence[int],
+                     stage_params: Sequence[Any],
+                     shared_params: Optional[Dict[str, Any]] = None,
+                     shared_specs: Optional[Dict[str, Any]] = None,
+                     sample_x: Optional[jax.Array] = None,
+                     rng: Optional[jax.Array] = None):
+    """Build a PipeSpec whose stages run different programs.
+
+    ``programs``: K stage functions ``prog(params, x, rng) -> x`` (each
+    must preserve the boundary activation shape). ``stage_programs``:
+    length-P list mapping stage -> program index. ``stage_params``:
+    length-P list of param trees; stage s's tree must match the
+    structure of its program's params (stages sharing a program need
+    identical leaf shapes). ``sample_x``: optional boundary-shaped array
+    to shape-check every program at build time.
+    """
+    from ...models.gpt2_pipe import PipeSpec
+    from .spmd import pipeline_param_shardings
+
+    K, Pn = len(programs), len(stage_programs)
+    if sorted(set(stage_programs)) != list(range(K)):
+        raise ValueError(f"stage_programs {list(stage_programs)} must use "
+                         f"every program index 0..{K - 1}")
+    if len(stage_params) != Pn:
+        raise ValueError(f"need one param tree per stage: "
+                         f"{len(stage_params)} != {Pn}")
+
+    # Per-program stacked params: [P, ...] with zeros on unowned stages.
+    templates: Dict[int, Any] = {}
+    for s, p in enumerate(stage_programs):
+        t = templates.setdefault(p, stage_params[s])
+        if jax.tree_util.tree_structure(stage_params[s]) != \
+                jax.tree_util.tree_structure(t):
+            raise ValueError(f"stage {s} param structure differs from "
+                             f"program {p}'s other stages")
+    blocks = {}
+    for p in range(K):
+        slices = [stage_params[s] if stage_programs[s] == p else
+                  jax.tree_util.tree_map(jnp.zeros_like, templates[p])
+                  for s in range(Pn)]
+        blocks[f"prog{p}"] = jax.tree_util.tree_map(
+            lambda *xs: jnp.stack(xs), *slices)
+
+    if sample_x is not None:
+        key = rng if rng is not None else jax.random.PRNGKey(0)
+        want = jnp.asarray(sample_x).shape
+        for p in range(K):
+            got = jax.eval_shape(programs[p], templates[p],
+                                 jax.ShapeDtypeStruct(want, jnp.float32),
+                                 key).shape
+            if got != want:
+                raise ValueError(
+                    f"program {p} changes the boundary shape {want} -> "
+                    f"{got}; pipeline stages must preserve it (the "
+                    "ppermute buffer is one uniform array)")
+
+    table = jnp.asarray(list(stage_programs), jnp.int32)
+
+    def stage_fn(blocks_local, x, rng):
+        r = lax.axis_index(PP_AXIS)
+        # blocks_local leaves carry the [P]-sharded leading dim (length 1
+        # per rank under pp=P meshes): drop it to this stage's slice.
+        outs = [programs[p](
+            jax.tree_util.tree_map(lambda a: a[0],
+                                   blocks_local[f"prog{p}"]), x, rng)
+            for p in range(K)]
+        return outs[0] if K == 1 else lax.select_n(table[r], *outs)
+
+    shardings = pipeline_param_shardings(
+        shared_specs=shared_specs or
+        jax.tree_util.tree_map(lambda _: P(), shared_params or {}),
+        block_specs=jax.tree_util.tree_map(lambda _: P(), blocks))
+    return PipeSpec(embed_fn=embed_fn, stage_fn=stage_fn, head_fn=head_fn,
+                    params={"shared": shared_params or {}, "blocks": blocks},
+                    shardings=shardings, num_layers=Pn,
+                    stage_layers=[1] * Pn)
