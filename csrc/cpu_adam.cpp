@@ -41,7 +41,7 @@ void ds_adam_step(float* __restrict params,
   const float bc1 = 1.0f - std::pow(beta1, (float)step);
   const float bc2 = 1.0f - std::pow(beta2, (float)step);
   const float step_size = lr / bc1;
-  const float bc2_sqrt = std::sqrt(bc2);
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
   const float omb1 = 1.0f - beta1;
   const float omb2 = 1.0f - beta2;
   const float decay = weight_decay;
@@ -55,7 +55,7 @@ void ds_adam_step(float* __restrict params,
     float v = exp_avg_sq[i] * beta2 + g * g * omb2;
     exp_avg[i] = m;
     exp_avg_sq[i] = v;
-    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
     // AdamW: decoupled decay applied directly to p (p -= lr * wd * p).
     params[i] = p - step_size * (m / denom) -
                 (adamw_mode ? lr * decay * p : 0.0f);
@@ -85,12 +85,12 @@ void ds_adam_step_plus_copy(float* __restrict params,
   const float bc1 = 1.0f - std::pow(beta1, (float)step);
   const float bc2 = 1.0f - std::pow(beta2, (float)step);
   const float step_size = lr / bc1;
-  const float bc2_sqrt = std::sqrt(bc2);
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
   const float omb1 = 1.0f - beta1;
   const float omb2 = 1.0f - beta2;
   const float decay = weight_decay;
 
-#pragma omp parallel for schedule(static)
+#pragma omp parallel for simd schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     float g = grads[i] * grad_scale;
     float p = params[i];
@@ -99,12 +99,101 @@ void ds_adam_step_plus_copy(float* __restrict params,
     float v = exp_avg_sq[i] * beta2 + g * g * omb2;
     exp_avg[i] = m;
     exp_avg_sq[i] = v;
-    float denom = std::sqrt(v) / bc2_sqrt + eps;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
     float newp = p - step_size * (m / denom) -
                  (adamw_mode ? lr * decay * p : 0.0f);
     params[i] = newp;
     params_bf16[i] = f32_to_bf16(newp);
   }
+}
+
+// bf16 -> f32 (the exact widening XLA's convert performs).
+static inline float bf16_to_f32(uint16_t h) {
+  uint32_t x = ((uint32_t)h) << 16;
+  float f;
+  __builtin_memcpy(&f, &x, 4);
+  return f;
+}
+
+// Adam step consuming BF16 gradients directly (the dtype ZeRO-Offload
+// grads arrive in from the device): kills the separate host-side
+// bf16->f32 cast pass AND halves the gradient memory traffic. Fused with
+// the bf16 staging copy like ds_adam_step_plus_copy.
+void ds_adam_step_plus_copy_bf16g(float* __restrict params,
+                                  const uint16_t* __restrict grads_bf16,
+                                  float* __restrict exp_avg,
+                                  float* __restrict exp_avg_sq,
+                                  uint16_t* __restrict params_bf16,
+                                  int64_t n, int32_t step,
+                                  float lr, float beta1, float beta2,
+                                  float eps, float weight_decay,
+                                  int32_t adamw_mode, float grad_scale) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float decay = weight_decay;
+
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = bf16_to_f32(grads_bf16[i]) * grad_scale;
+    float p = params[i];
+    if (!adamw_mode && decay != 0.0f) g += decay * p;
+    float m = exp_avg[i] * beta1 + g * omb1;
+    float v = exp_avg_sq[i] * beta2 + g * g * omb2;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+    float newp = p - step_size * (m / denom) -
+                 (adamw_mode ? lr * decay * p : 0.0f);
+    params[i] = newp;
+    params_bf16[i] = f32_to_bf16(newp);
+  }
+}
+
+// Same, without the staging copy.
+void ds_adam_step_bf16g(float* __restrict params,
+                        const uint16_t* __restrict grads_bf16,
+                        float* __restrict exp_avg,
+                        float* __restrict exp_avg_sq,
+                        int64_t n, int32_t step,
+                        float lr, float beta1, float beta2, float eps,
+                        float weight_decay, int32_t adamw_mode,
+                        float grad_scale) {
+  const float bc1 = 1.0f - std::pow(beta1, (float)step);
+  const float bc2 = 1.0f - std::pow(beta2, (float)step);
+  const float step_size = lr / bc1;
+  const float inv_bc2_sqrt = 1.0f / std::sqrt(bc2);
+  const float omb1 = 1.0f - beta1;
+  const float omb2 = 1.0f - beta2;
+  const float decay = weight_decay;
+
+#pragma omp parallel for simd schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    float g = bf16_to_f32(grads_bf16[i]) * grad_scale;
+    float p = params[i];
+    if (!adamw_mode && decay != 0.0f) g += decay * p;
+    float m = exp_avg[i] * beta1 + g * omb1;
+    float v = exp_avg_sq[i] * beta2 + g * g * omb2;
+    exp_avg[i] = m;
+    exp_avg_sq[i] = v;
+    float denom = std::sqrt(v) * inv_bc2_sqrt + eps;
+    params[i] = p - step_size * (m / denom) -
+                (adamw_mode ? lr * decay * p : 0.0f);
+  }
+}
+
+double ds_grad_norm_sq_bf16(const uint16_t* __restrict grads_bf16, int64_t n,
+                            float grad_scale) {
+  double acc = 0.0;
+#pragma omp parallel for simd reduction(+ : acc) schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double g = (double)(bf16_to_f32(grads_bf16[i]) * grad_scale);
+    acc += g * g;
+  }
+  return acc;
 }
 
 // L2 norm of a scaled gradient span (overflow/clip decision happens on the

@@ -54,7 +54,26 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.ds_adam_step_plus_copy.restype = None
     lib.ds_grad_norm_sq.argtypes = [_f32p, ctypes.c_int64, ctypes.c_float]
     lib.ds_grad_norm_sq.restype = ctypes.c_double
+    lib.ds_adam_step_bf16g.argtypes = [
+        _f32p, _u16p, _f32p, _f32p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int32, ctypes.c_float]
+    lib.ds_adam_step_bf16g.restype = None
+    lib.ds_adam_step_plus_copy_bf16g.argtypes = [
+        _f32p, _u16p, _f32p, _f32p, _u16p, ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+        ctypes.c_float, ctypes.c_int32, ctypes.c_float]
+    lib.ds_adam_step_plus_copy_bf16g.restype = None
+    lib.ds_grad_norm_sq_bf16.argtypes = [_u16p, ctypes.c_int64,
+                                         ctypes.c_float]
+    lib.ds_grad_norm_sq_bf16.restype = ctypes.c_double
     return lib
+
+
+def _is_bf16(a) -> bool:
+    """ml_dtypes.bfloat16 ndarray."""
+    d = getattr(a, "dtype", None)
+    return d is not None and getattr(d, "name", "") == "bfloat16"
 
 
 _LIB: Optional[ctypes.CDLL] = None
@@ -131,8 +150,24 @@ class DeepSpeedCPUAdam:
         for i, (p, g) in enumerate(zip(master_leaves, grad_leaves)):
             assert p.dtype == np.float32 and p.flags["C_CONTIGUOUS"], \
                 "masters must be contiguous fp32"
-            g = np.ascontiguousarray(np.asarray(g, np.float32))
             m, v = self.exp_avg[i], self.exp_avg_sq[i]
+            if self._lib is not None and _is_bf16(g):
+                # BF16 grads straight into the kernel: no host-side cast
+                # pass, half the gradient read traffic.
+                gb = np.ascontiguousarray(g).view(np.uint16)
+                if bf16_out is not None:
+                    self._lib.ds_adam_step_plus_copy_bf16g(
+                        _ptr(p), _ptr(gb, _u16p), _ptr(m), _ptr(v),
+                        _ptr(bf16_out[i], _u16p), p.size, self.step_count,
+                        lr, b1, b2, self.eps, self.weight_decay,
+                        int(self.adamw_mode), grad_scale)
+                else:
+                    self._lib.ds_adam_step_bf16g(
+                        _ptr(p), _ptr(gb, _u16p), _ptr(m), _ptr(v), p.size,
+                        self.step_count, lr, b1, b2, self.eps,
+                        self.weight_decay, int(self.adamw_mode), grad_scale)
+                continue
+            g = np.ascontiguousarray(np.asarray(g, np.float32))
             if self._lib is not None:
                 if bf16_out is not None:
                     self._lib.ds_adam_step_plus_copy(
@@ -171,6 +206,11 @@ class DeepSpeedCPUAdam:
         """Global L2 norm of the (scaled) gradients, host-side."""
         acc = 0.0
         for g in grad_leaves:
+            if self._lib is not None and _is_bf16(g):
+                gb = np.ascontiguousarray(g).view(np.uint16)
+                acc += float(self._lib.ds_grad_norm_sq_bf16(
+                    _ptr(gb, _u16p), gb.size, grad_scale))
+                continue
             g = np.ascontiguousarray(np.asarray(g, np.float32))
             if self._lib is not None:
                 acc += float(self._lib.ds_grad_norm_sq(

@@ -22,7 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ... import constants as C
-from ...ops.cpu_adam import DeepSpeedCPUAdam, _f32_to_bf16_np, host_f32
+from ...ops.cpu_adam import (DeepSpeedCPUAdam, _f32_to_bf16_np, _is_bf16,
+                             host_f32)
 from ...utils.logging import log_dist
 
 # Optimizers that may drive offloaded state (reference zero/utils.py:41
@@ -171,7 +172,14 @@ class ZeroOffloadOptimizer:
 
         Grad leaves may be full-shaped (sliced here to the local partition)
         or already partition-local."""
-        g_leaves = [self.slice_leaf(i, np.asarray(g, np.float32))
+        # bf16 grads stay bf16: the native Adam/norm kernels widen inline
+        # (ops/cpu_adam.py), which removes a full-tree host cast pass and
+        # halves the gradient read traffic on the offload host.
+        def to_host(g):
+            a = np.asarray(g)
+            return a if _is_bf16(a) else np.asarray(a, np.float32)
+
+        g_leaves = [self.slice_leaf(i, to_host(g))
                     for i, g in enumerate(jax.tree_util.tree_leaves(grads))]
         inv_scale = 1.0 / self.loss_scale
         if self.partition_num > 1:

@@ -40,3 +40,28 @@ def test_lamb_min_coeff_clamp():
     u, _ = tx.update(g, st, p)
     # unclamped ratio would be ~1e-6; min_coeff forces >= 0.5
     assert np.abs(np.asarray(u["w"])).mean() > 0.4
+
+
+def test_cpu_adam_bf16_grad_kernel_parity():
+    """The bf16-gradient Adam kernels (no host-side cast pass) match the
+    fp32 fallback math exactly, and the bf16 norm matches f64."""
+    import ml_dtypes
+    from deepspeed_tpu.ops.cpu_adam import DeepSpeedCPUAdam
+    rng = np.random.default_rng(0)
+    g32 = rng.standard_normal(4097).astype(np.float32)
+    g16 = g32.astype(ml_dtypes.bfloat16)          # bf16-representable grads
+    g32 = g16.astype(np.float32)
+    p_a = np.ones(4097, np.float32)
+    p_b = np.ones(4097, np.float32)
+    opt_a = DeepSpeedCPUAdam({"w": p_a}, lr=1e-3, weight_decay=0.01)
+    opt_b = DeepSpeedCPUAdam({"w": p_b}, lr=1e-3, weight_decay=0.01)
+    opt_b._lib = None                             # numpy reference path
+    bo = [np.zeros(4097, np.uint16)]
+    for _ in range(3):
+        opt_a.step([p_a], [g16], grad_scale=0.5, bf16_out=bo)
+        opt_b.step([p_b], [g32], grad_scale=0.5)
+    np.testing.assert_allclose(p_a, p_b, rtol=1e-6, atol=1e-7)
+    if opt_a.native:
+        n_a = opt_a.grad_norm([g16], 0.5)
+        n_ref = float(np.sqrt(np.sum((g32.astype(np.float64) * 0.5) ** 2)))
+        np.testing.assert_allclose(n_a, n_ref, rtol=1e-6)
