@@ -25,8 +25,9 @@ import pytest  # noqa: E402
 
 def pytest_configure(config):
     config.addinivalue_line(
-        "markers", "slow: long-running convergence tests (run by default; "
-        "deselect with -m 'not slow')")
+        "markers", "slow: long-running tier — convergence runs, pipeline "
+        "engine end-to-ends, HF-parity suites (run by default; the fast "
+        "tier is -m 'not slow', ~3 min on the 8-device CPU mesh)")
 
 
 @pytest.fixture

@@ -80,6 +80,7 @@ class TestPrecision:
         # master weights stay fp32
         assert jax.device_get(engine.state.params)["w1"].dtype == np.float32
 
+    @pytest.mark.slow
     def test_fp16_trains(self):
         engine = make_engine(base_config(
             fp16={"enabled": True, "initial_scale_power": 8}))
@@ -87,6 +88,7 @@ class TestPrecision:
         losses = [float(engine.train_batch(batch=batch)) for _ in range(15)]
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_fp16_overflow_skips_step(self):
         """NaN injection parity with test_dynamic_loss_scale.py."""
         engine = make_engine(base_config(
@@ -103,6 +105,7 @@ class TestPrecision:
         assert int(jax.device_get(engine.state.skipped_steps)) == 1
         assert int(jax.device_get(engine.state.step)) == 0
 
+    @pytest.mark.slow
     def test_fp16_hysteresis(self):
         engine = make_engine(base_config(
             fp16={"enabled": True, "initial_scale_power": 8, "hysteresis": 2}))
@@ -133,6 +136,7 @@ class TestPrecision:
 
 
 class TestZero:
+    @pytest.mark.slow
     @pytest.mark.parametrize("stage", [0, 1, 2])
     def test_zero_matches_stage0(self, stage):
         """Loss-curve parity across ZeRO stages (reference test style)."""

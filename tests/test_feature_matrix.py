@@ -33,6 +33,7 @@ MATRIX = [
 ]
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("opt,prec,stage,offload", MATRIX)
 def test_config_combination_trains(opt, prec, stage, offload):
     dp = 1 if offload else 2

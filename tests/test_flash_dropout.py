@@ -15,6 +15,8 @@ import pytest
 
 from deepspeed_tpu.ops import flash_attention as fa
 
+pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
+
 
 def _make_qkv(key, B, S, nH, D, dtype=jnp.float32):
     ks = jax.random.split(key, 3)

@@ -112,6 +112,7 @@ class TestLaunchSmoke:
         return subprocess.run(cmd, env=env, cwd=str(tmp_path),
                               capture_output=True, text=True, timeout=timeout)
 
+    @pytest.mark.slow
     def test_two_process_launch_env_contract(self, tmp_path):
         """Both children run with the DS_* env contract populated."""
         res = self._run_launch(tmp_path, """\
@@ -139,6 +140,7 @@ class TestLaunchSmoke:
         assert {got[0][3], got[1][3]} == {"0", "1"}
         assert got[0][5] == "0,1"  # slot visibility from the hostfile
 
+    @pytest.mark.slow
     def test_failed_child_kills_siblings(self, tmp_path):
         """One child exiting nonzero must take the node down (reference
         launch.py:151-167 sigkill_handler semantics)."""

@@ -51,6 +51,7 @@ class TestGPT2:
         assert not np.allclose(np.asarray(l1[0, 10], np.float32),
                                np.asarray(l2[0, 10], np.float32))
 
+    @pytest.mark.slow
     def test_loss_decreases(self, tiny_gpt2):
         cfg, params = tiny_gpt2
         loss_fn = gpt2_loss_fn(cfg)

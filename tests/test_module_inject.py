@@ -20,6 +20,8 @@ from deepspeed_tpu.module_inject import (bert_config_from_hf,
                                          restore_bert_encoder,
                                          restore_gpt2_blocks)
 
+pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
+
 
 @pytest.fixture(scope="module")
 def tiny_bert():

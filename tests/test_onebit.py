@@ -24,6 +24,7 @@ def _params(seed=0):
     return simple_model_params(jax.random.PRNGKey(seed))
 
 
+@pytest.mark.slow
 def test_warmup_matches_plain_adam():
     """Steps <= freeze_step are bias-corrected Adam on the averaged grads."""
     lr, b1, b2, eps = 1e-2, 0.9, 0.999, 1e-8
@@ -47,6 +48,7 @@ def test_warmup_matches_plain_adam():
                                    rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_variance_frozen_after_warmup():
     params = _params()
     st = init_state(params)
@@ -66,6 +68,7 @@ def test_variance_frozen_after_warmup():
         np.testing.assert_array_equal(a, np.asarray(b))
 
 
+@pytest.mark.slow
 def test_error_feedback_bounded_and_unbiased():
     """Error feedback: cumulative transmitted momentum tracks cumulative
     true momentum — the error buffer stays bounded rather than growing."""

@@ -14,6 +14,8 @@ from deepspeed_tpu.models.gpt2 import gpt2_loss_fn
 from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
 from deepspeed_tpu.parallel.topology import build_mesh
 
+pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
+
 
 @pytest.fixture(scope="module")
 def cfg():

@@ -16,6 +16,8 @@ from deepspeed_tpu.models.gpt2_pipe import gpt2_pipe_spec
 from deepspeed_tpu.parallel.topology import build_mesh
 from deepspeed_tpu.runtime.pipe.module import LayerSpec, PipelineModule
 
+pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
+
 
 @pytest.fixture(scope="module")
 def cfg():

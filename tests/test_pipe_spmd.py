@@ -44,6 +44,7 @@ class TestSpmdPipeline:
                                        jax.random.PRNGKey(2)))
         np.testing.assert_allclose(got, want, rtol=2e-2)
 
+    @pytest.mark.slow
     def test_pipeline_grads_match_sequential(self, cfg):
         spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))
         mesh = build_mesh(pp=4, dp=2)
@@ -68,6 +69,7 @@ class TestSpmdPipeline:
             np.asarray(g_pipe["shared"]["wte"], np.float32),
             np.asarray(g_seq["wte"], np.float32), rtol=5e-2, atol=5e-3)
 
+    @pytest.mark.slow
     def test_engine_end_to_end_pp2_dp2_mp2(self, cfg):
         """Full 3D: PipelineEngine trains and the loss falls (pp2 dp2 mp2)."""
         spec = gpt2_pipe_spec(cfg, rng=jax.random.PRNGKey(0))

@@ -10,6 +10,7 @@ import dataclasses
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from deepspeed_tpu.models.transformer import (TransformerConfig, apply_blocks,
                                               init_block_params)
@@ -80,6 +81,7 @@ def test_pld_off_is_default():
     assert abs(float(out.mean()) - 4.0) < 1e-5
 
 
+@pytest.mark.slow
 def test_engine_pld_trains():
     """Engine with PLD enabled: theta threads into gpt2_loss_fn and the
     model still trains."""

@@ -159,6 +159,7 @@ class TestSparsePallasPath:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=2e-2, atol=2e-2)
 
+    @pytest.mark.slow
     def test_kernel_grads_match_reference(self):
         q, k, v = self._qkv()
         layout = FixedSparsityConfig(num_heads=2, block=128,
@@ -203,6 +204,7 @@ class TestWidenedKBlocks:
 
     @pytest.mark.parametrize("widen,causal", [(2, False), (2, True),
                                               (4, True)])
+    @pytest.mark.slow
     def test_widened_matches_unwidened(self, widen, causal):
         import math
         from deepspeed_tpu.ops.sparse_flash import sparse_flash_attention
