@@ -1,8 +1,9 @@
 """Dev tool: block-sparse attention speedup vs dense-causal flash.
 
 Reproduces the VERDICT metric: BigBird layout, S=32768, D=64, fwd+bwd,
-vs the dense causal kernel at the same shapes. Sweeps the k-widening
-factor. Usage: python bench_sparse.py [S] [widens...]
+vs the dense causal kernel at the same shapes. Sweeps super-tile factors:
+bare ints are k-widening, "QxK" pairs (e.g. 2x4) widen both dims.
+Usage: python bench_sparse.py [S] [tiles...]
 """
 import math
 import sys
@@ -18,7 +19,14 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     BigBirdSparsityConfig)
 
 S = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
-WIDENS = [int(w) for w in sys.argv[2:]] or [1, 2, 4]
+
+
+def _tile(a):
+    return tuple(int(x) for x in a.split("x")) if "x" in a else (1, int(a))
+
+
+TILES = [_tile(a) for a in sys.argv[2:]] or \
+    [(1, 1), (1, 4), (2, 2), (2, 4), (4, 2), (2, 8), (4, 4)]
 B, NH, D = 1, 4, 64
 N = 10
 
@@ -69,12 +77,12 @@ def dense_fb(c):
     return (dq + dk + dv).astype(c.dtype)
 
 
-def sparse_fb(widen):
+def sparse_fb(widen, qwiden):
     def fb(c):
         def f(qq, kk, vv):
             o = sf.sparse_flash_attention(qq, kk, vv, layout, causal=True,
                                           scale=scale, seed=seed,
-                                          widen=widen)
+                                          widen=widen, qwiden=qwiden)
             return jnp.sum(o.astype(jnp.float32) ** 2)
         dq, dk, dv = jax.grad(f, argnums=(0, 1, 2))(c, k, v)
         return (dq + dk + dv).astype(c.dtype)
@@ -83,14 +91,16 @@ def sparse_fb(widen):
 
 t_dense = timeit(dense_fb)
 print(f"dense causal : {t_dense:8.1f} ms fwd+bwd", flush=True)
-for w in WIDENS:
+auto = sf.pick_tile(np.asarray(layout), block=S // layout.shape[1])
+print(f"pick_tile auto: qw={auto[0]} kw={auto[1]}", flush=True)
+for qw, w in TILES:
     lay2 = np.asarray(layout) != 0
     H_, nQ_, nK_ = lay2.shape
-    if nK_ % w != 0:
-        print(f"sparse w={w}  : skipped (nK={nK_} not divisible; kernel "
-              "falls back to w=1)", flush=True)
+    if nK_ % w != 0 or nQ_ % qw != 0 or qw * w > 31:
+        print(f"sparse {qw}x{w}: skipped (indivisible or >31 bits)",
+              flush=True)
         continue
-    nnz_w = int(lay2.reshape(H_, nQ_, nK_ // w, w).any(-1).sum())
-    t = timeit(sparse_fb(w))
-    print(f"sparse w={w}  : {t:8.1f} ms fwd+bwd  ({t_dense/t:4.2f}x vs "
+    nnz_w = sf.supertile_nnz(lay2, qw, w)
+    t = timeit(sparse_fb(w, qw))
+    print(f"sparse q{qw}xk{w}: {t:8.1f} ms fwd+bwd  ({t_dense/t:4.2f}x vs "
           f"dense; steps/head ~{nnz_w//H_})", flush=True)

@@ -39,31 +39,36 @@ except Exception:  # pragma: no cover
     pltpu = None
 
 
-def build_flat_luts(layout: np.ndarray, widen: int = 1):
+def build_flat_luts(layout: np.ndarray, widen: int = 1, qwiden: int = 1):
     """layout [H, nQ, nK] -> (qid, kid, nnz, kmask, qidT, kidT, nnzT,
     kmaskT) int32 arrays ([H, NNZ] / [H]), row-major for fwd/dq and
     column-major for dkv; padded tails repeat the last pair. None if any
     row/column is empty.
 
-    ``widen`` > 1 coarsens the K dimension by that factor: one LUT entry
-    covers ``widen`` adjacent 1-wide k-blocks (kid indexes WIDE blocks)
-    and ``kmask`` is a per-entry bitmask of which sub-blocks are live
-    (inactive sub-columns are softmax-masked in-kernel). Window-shaped
-    layouts (local attention bands) coarsen nearly for free, and each grid
-    step's matmuls grow ``widen``x — amortizing the fixed per-step cost
-    that dominates at head-dim 64 (see sparse_flash_attention's auto
-    pick). Padded tail entries carry kmask=0, so they are hard no-ops."""
+    ``widen``/``qwiden`` > 1 coarsen the K/Q dimensions by those factors:
+    one LUT entry covers a ``qwiden x widen`` super-tile of base blocks
+    (qid/kid index WIDE blocks) and ``kmask`` is a per-entry bitmask of
+    which sub-blocks are live — bit ``sq * widen + sk`` for sub-row sq,
+    sub-col sk; dead sub-blocks are softmax-masked in-kernel. Banded
+    layouts (local attention) coarsen nearly for free in BOTH dims, and
+    each grid step's matmuls grow ``qwiden*widen``x — amortizing the fixed
+    per-step sequencing cost that dominates at head-dim 64, and deepening
+    the MXU tiles (a 128-row step at D=64 underfills the systolic array;
+    qwiden=2+ feeds it 256+ rows). Padded tail entries carry kmask=0, so
+    they are hard no-ops."""
     lay = np.asarray(layout) != 0
     H, nQ, nK = lay.shape
     if (lay.sum(-1) == 0).any() or (lay.sum(-2) == 0).any():
         return None
-    w = int(widen)
-    if nK % w != 0:
+    w, qw = int(widen), int(qwiden)
+    if nK % w != 0 or nQ % qw != 0 or qw * w > 31:
         return None
-    nK2 = nK // w
-    # bits[h, q, k2] = bitmask of live sub-blocks in wide block k2
-    sub = lay.reshape(H, nQ, nK2, w)
-    bits = (sub.astype(np.int32) << np.arange(w, dtype=np.int32)).sum(-1)
+    nK2, nQ2 = nK // w, nQ // qw
+    # bits[h, q2, k2]: bit (sq * w + sk) = live(sub-row sq, sub-col sk)
+    sub = lay.reshape(H, nQ2, qw, nK2, w).transpose(0, 1, 3, 2, 4)
+    flat = sub.reshape(H, nQ2, nK2, qw * w)
+    bits = (flat.astype(np.int64) <<
+            np.arange(qw * w, dtype=np.int64)).sum(-1).astype(np.int32)
 
     def flatten(mask, bit_lookup):   # row-major active pairs per head
         pairs = [np.argwhere(mask[h]) for h in range(H)]
@@ -91,21 +96,24 @@ def build_flat_luts(layout: np.ndarray, widen: int = 1):
 # --------------------------------------------------------------------- #
 # Kernels — grid (BH, NNZ); state carries across same-row steps
 # --------------------------------------------------------------------- #
-def _submask(s, bits, bk: int, widen: int, transposed: bool = False):
-    """NEG_INF-mask the sub-blocks of a widened k tile whose LUT bit is 0.
-    s: [bq, bk] (or [bk, bq] transposed), bk = widen * sub_width."""
-    if widen == 1:
+def _submask(s, bits, bq: int, bk: int, qwiden: int, widen: int,
+             transposed: bool = False):
+    """NEG_INF-mask the sub-blocks of a qwiden x widen super-tile whose
+    LUT bit is 0. s: [bq, bk] (or [bk, bq] transposed); bit index is
+    sub_q * widen + sub_k."""
+    if widen == 1 and qwiden == 1:
         return s
-    subw = bk // widen
-    axis = 0 if transposed else 1
-    sub = jax.lax.broadcasted_iota(jnp.int32, s.shape, axis) // subw
-    live = jax.lax.shift_right_logical(bits, sub) & 1
+    subq, subk = bq // qwiden, bk // widen
+    q_axis, k_axis = (1, 0) if transposed else (0, 1)
+    sq = jax.lax.broadcasted_iota(jnp.int32, s.shape, q_axis) // subq
+    sk = jax.lax.broadcasted_iota(jnp.int32, s.shape, k_axis) // subk
+    live = jax.lax.shift_right_logical(bits, sq * widen + sk) & 1
     return jnp.where(live == 1, s, NEG_INF)
 
 
 def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
                  seed_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                 *, scale, causal, bq, bk, nH, dropout, widen):
+                 *, scale, causal, bq, bk, nH, dropout, widen, qwiden):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     qi = qid_ref[h, n]
@@ -128,7 +136,7 @@ def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, bq, bk)
-        s = _submask(s, kmask_ref[h, n], bk, widen)
+        s = _submask(s, kmask_ref[h, n], bq, bk, qwiden, widen)
         m_prev = m_scr[:, 0:1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
@@ -162,7 +170,7 @@ def _sfwd_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
 
 def _sdq_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
                 do_ref, lse_ref, delta_ref, seed_ref, dq_ref, acc_scr,
-                *, scale, causal, bq, bk, nH, dropout, widen):
+                *, scale, causal, bq, bk, nH, dropout, widen, qwiden):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     qi = qid_ref[h, n]
@@ -185,7 +193,7 @@ def _sdq_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, qi, kj, bq, bk)
-        s = _submask(s, kmask_ref[h, n], bk, widen)
+        s = _submask(s, kmask_ref[h, n], bq, bk, qwiden, widen)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -211,7 +219,7 @@ def _sdq_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
 def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref, k_ref,
                  v_ref, do_ref, lse_ref, delta_ref, seed_ref, dk_ref, dv_ref,
                  dk_scr, dv_scr, *, scale, causal, bq, bk, nH, dropout,
-                 widen):
+                 widen, qwiden):
     bh, n = pl.program_id(0), pl.program_id(1)
     h = bh % nH
     kj = kidT_ref[h, n]
@@ -235,7 +243,8 @@ def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref, k_ref,
             preferred_element_type=jnp.float32) * scale
         if causal:
             s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
-        s2 = _submask(s2, kmaskT_ref[h, n], bk, widen, transposed=True)
+        s2 = _submask(s2, kmaskT_ref[h, n], bq, bk, qwiden, widen,
+                      transposed=True)
         p2 = jnp.exp(s2 - lse)
         if dropout > 0.0:
             keep2 = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk,
@@ -272,12 +281,12 @@ def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref, k_ref,
 # pallas_call wrappers
 # --------------------------------------------------------------------- #
 def _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal, nH, bq,
-                bk, dropout, widen):
+                bk, dropout, widen, qwiden):
     BH, S, D = q.shape
     NNZ = qid.shape[-1]
     kernel = functools.partial(_sfwd_kernel, scale=scale, causal=causal,
                                bq=bq, bk=bk, nH=nH, dropout=dropout,
-                               widen=widen)
+                               widen=widen, qwiden=qwiden)
     o, lse = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -318,7 +327,7 @@ def _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal, nH, bq,
 
 
 def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
-                dropout, widen):
+                dropout, widen, qwiden):
     qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT = luts
     BH, S, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
@@ -326,7 +335,8 @@ def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
 
     dq = pl.pallas_call(
         functools.partial(_sdq_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen),
+                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen,
+                          qwiden=qwiden),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(BH, qid.shape[-1]),
@@ -361,7 +371,8 @@ def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
 
     dk, dv = pl.pallas_call(
         functools.partial(_sdkv_kernel, scale=scale, causal=causal,
-                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen),
+                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen,
+                          qwiden=qwiden),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=4,
             grid=(BH, kidT.shape[-1]),
@@ -408,73 +419,113 @@ def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(12, 13, 14, 15, 16, 17, 18))
+                   nondiff_argnums=(12, 13, 14, 15, 16, 17, 18, 19))
 def _sparse_flash(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
-                  seed, scale, causal, nH, bq, bk, dropout, widen):
+                  seed, scale, causal, nH, bq, bk, dropout, widen, qwiden):
     o, _ = _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal,
-                       nH, bq, bk, dropout, widen)
+                       nH, bq, bk, dropout, widen, qwiden)
     return o
 
 
 def _sparse_vjp_fwd(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
-                    seed, scale, causal, nH, bq, bk, dropout, widen):
+                    seed, scale, causal, nH, bq, bk, dropout, widen, qwiden):
     o, lse = _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal,
-                         nH, bq, bk, dropout, widen)
+                         nH, bq, bk, dropout, widen, qwiden)
     from .flash_attention import _tag_residuals
     o, lse = _tag_residuals(o, lse)
     return o, (q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT,
                seed, o, lse)
 
 
-def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, widen, res, do):
+def _sparse_vjp_bwd(scale, causal, nH, bq, bk, dropout, widen, qwiden, res,
+                    do):
     (q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT, seed, o,
      lse) = res
     dq, dk, dv = _sparse_bwd(
         q, k, v, o, lse, do,
         (qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT), seed,
-        scale, causal, nH, bq, bk, dropout, widen)
+        scale, causal, nH, bq, bk, dropout, widen, qwiden)
     return (dq, dk, dv) + (None,) * 9
 
 
 _sparse_flash.defvjp(_sparse_vjp_fwd, _sparse_vjp_bwd)
 
 
-# Per-grid-step fixed cost (Mosaic sequencing latency, ~2 us on v5e),
-# expressed in block-compute units: one unit = a 128x128 tile's work, so
-# at base block b the fixed cost is ALPHA_128 * (128/b)^2 units. The auto
-# picker charges candidate widening w a cost of nnz_w * (alpha + w) and
-# takes the cheapest. Calibrated on v5e BigBird sweeps (S=32768, D=64):
-# block=128 w=1/2/4/8/16 -> 19.8/19.0/14.4/16.3/20.5 ms; block=256
-# w=1/2 -> 22.6/21.7; block=512 w=1/2 -> 17.0/19.7 — alpha=16*(128/b)^2
-# reproduces all three measured orderings.
-_WIDEN_ALPHA_128 = 16.0
+# Per-grid-step fixed cost (Mosaic sequencing latency), expressed in
+# block-compute units: one unit = a 128x128 tile's work, so at base block
+# b the fixed cost is ALPHA_128 * (128/b)^2 units. The auto picker
+# charges candidate super-tile (qw, kw) a cost of
+# nnz_{qw,kw} * (alpha + qw*kw + QW_PENALTY*(qw-1)) and takes the
+# cheapest. Round-5 calibration from the v5e BigBird sweep (S=32768,
+# D=64, block=128, fwd+bwd): 1x1/1x4/2x2/2x4/4x2/2x8/4x4 ->
+# 19.4/16.2/17.2/15.7/17.7/18.9/18.3 ms fits t = steps*(3.75us +
+# 0.49us*blocks) => alpha ~= 7.7; the residual q-widening overhead (row
+# state grows with bq; measured q2k2 > q1k4 despite equal model cost) is
+# the QW_PENALTY term. The law also names the remaining ceiling: per
+# 128x128 block ~0.49us across three passes is MXU time on shallow
+# D=64-contraction dots — cutting it further needs a fused backward (one
+# s/p computation feeding dq+dk+dv, as the dense kernel does) rather
+# than better tiling.
+_WIDEN_ALPHA_128 = 7.7
+_QW_PENALTY = 1.0
 
 
 def pick_widen(layout: np.ndarray, block: int = 128,
                choices=(1, 2, 4, 8)) -> int:
+    """K-only tiling pick (kept for API compatibility): pick_tile with
+    q_choices=(1,)."""
+    return pick_tile(layout, block=block, k_choices=tuple(choices),
+                     q_choices=(1,))[1]
+
+
+def supertile_nnz(layout: np.ndarray, qw: int, kw: int) -> int:
+    """Occupied qw x kw super-tiles of a [H, nQ, nK] layout (= grid steps
+    per full pass at that tiling)."""
+    lay = np.asarray(layout) != 0
+    H, nQ, nK = lay.shape
+    return int(lay.reshape(H, nQ // qw, qw, nK // kw, kw)
+               .any(axis=(2, 4)).sum())
+
+
+def pick_tile(layout: np.ndarray, block: int = 128,
+              k_choices=(1, 2, 4, 8), q_choices=(1, 2)):
+    """(qwiden, kwiden) minimizing the calibrated step-cost model (see
+    _WIDEN_ALPHA_128). Banded layouts coarsen nearly for free in both
+    dimensions, so the optimum moves to super-tiles whose compute drowns
+    the fixed per-step cost; q_choices stops at 2 because measured
+    q-widening overhead outgrows its step savings beyond that."""
     lay = np.asarray(layout) != 0
     H, nQ, nK = lay.shape
     alpha = _WIDEN_ALPHA_128 * (128.0 / max(block, 1)) ** 2
-    best_w, best_cost = 1, None
-    for w in choices:
-        if nK % w != 0:
+    cands = {}
+    for qw in q_choices:
+        if nQ % qw != 0:
             continue
-        nnz_w = int(lay.reshape(H, nQ, nK // w, w).any(-1).sum())
-        cost = nnz_w * (alpha + w)
-        if best_cost is None or cost < best_cost:
-            best_w, best_cost = w, cost
-    return best_w
+        for kw in k_choices:
+            if nK % kw != 0 or qw * kw > 31:
+                continue
+            cands[(qw, kw)] = supertile_nnz(lay, qw, kw) * \
+                (alpha + qw * kw + _QW_PENALTY * (qw - 1))
+    if not cands:
+        return (1, 1)
+    lo = min(cands.values())
+    # The model cannot order near-ties (its residuals are ~8%); among
+    # those, the LARGEST super-tile measures fastest (deeper MXU work per
+    # step) — v5e sweep: q2k4 beats q1k4/q2k2 despite equal model cost.
+    near = [t for t, c in cands.items() if c <= 1.08 * lo]
+    return max(near, key=lambda t: (t[0] * t[1], t[1]))
 
 
 def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
                            seed=None, dropout: float = 0.0,
-                           widen: int = 0):
+                           widen: int = 0, qwiden: int = 0):
     """q,k,v: [BH, S, D] (batch*heads flattened); layout: CONCRETE
     [nH, nQ, nK] array with no empty rows/columns. Grid steps == nnz of
-    the (possibly k-widened) layout.
+    the (possibly super-tiled) layout.
 
-    ``widen``: 0 = auto (pick_widen cost model; DS_SPARSE_WIDEN overrides),
-    else an explicit k-coarsening factor."""
+    ``widen``/``qwiden``: 0 = auto (pick_tile cost model;
+    DS_SPARSE_WIDEN / DS_SPARSE_QWIDEN override), else explicit k/q
+    coarsening factors."""
     import os
     BH, S, D = q.shape
     nH = int(layout.shape[0])
@@ -482,18 +533,34 @@ def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
     bk = k.shape[1] // layout.shape[2]
     lay_np = np.asarray(layout)
     if widen == 0:
-        widen = int(os.environ.get("DS_SPARSE_WIDEN", "0")) or \
-            pick_widen(lay_np, block=bk)
-    if layout.shape[2] % widen != 0:
-        widen = 1          # non-dividing override/choice: plain 1-wide LUTs
-    luts = build_flat_luts(lay_np, widen=widen)
+        widen = int(os.environ.get("DS_SPARSE_WIDEN", "0"))
+    if qwiden == 0:
+        qwiden = int(os.environ.get("DS_SPARSE_QWIDEN", "0"))
+    if widen == 0 and qwiden == 0:
+        qwiden, widen = pick_tile(lay_np, block=bk)
+    # Pinning one factor explicitly leaves the other at 1 (not auto):
+    # callers sweeping a single dimension get exactly that dimension.
+    widen = widen or 1
+    qwiden = qwiden or 1
+    req = (qwiden, widen)
+    if layout.shape[2] % widen != 0 or widen > 31:
+        widen = 1          # non-dividing/overwide: plain 1-wide LUTs
+    if layout.shape[1] % qwiden != 0 or qwiden * widen > 31:
+        qwiden = 1
+    if (qwiden, widen) != req:
+        from ..utils.logging import logger
+        logger.warning(
+            f"sparse_flash_attention: requested super-tile q{req[0]}xk"
+            f"{req[1]} does not fit this layout (divisibility or the "
+            f"31-bit mask cap); running q{qwiden}xk{widen}")
+    luts = build_flat_luts(lay_np, widen=widen, qwiden=qwiden)
     if luts is None:
-        raise ValueError("layout has an empty row/column (or nK % widen "
-                         "!= 0); caller should use the gated kernel")
+        raise ValueError("layout has an empty q-block row or k-block "
+                         "column; caller should use the gated kernel")
     (qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT) = \
         (jnp.asarray(a) for a in luts)
     seed = jnp.zeros((1, 1), jnp.int32) if seed is None \
         else jnp.asarray(seed, jnp.int32).reshape(1, 1)
     return _sparse_flash(q, k, v, qid, kid, nnz, kmask, qidT, kidT, nnzT,
-                         kmaskT, seed, scale, causal, nH, bq, bk * widen,
-                         float(dropout), widen)
+                         kmaskT, seed, scale, causal, nH, bq * qwiden,
+                         bk * widen, float(dropout), widen, qwiden)

@@ -234,3 +234,52 @@ class TestWidenedKBlocks:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4,
                                        err_msg=f"d{name} widen={widen}")
+
+
+class TestSuperTiles:
+    """q x k super-tiled LUT kernels (2-D widening: one grid step covers a
+    qwiden x widen block tile, dead sub-blocks softmax-masked via the 2-D
+    bitmask) must match the 1x1 path exactly for outputs AND grads."""
+
+    @pytest.mark.parametrize("qw,kw,causal", [(2, 1, False), (2, 2, True),
+                                              (4, 2, False), (2, 4, True)])
+    @pytest.mark.slow
+    def test_supertile_matches_base(self, qw, kw, causal):
+        import math
+        from deepspeed_tpu.ops.sparse_flash import sparse_flash_attention
+        rng = np.random.default_rng(5)
+        nH, S, D, block = 2, 1024, 64, 128
+        nB = S // block
+        lay = (rng.random((nH, nB, nB)) < 0.3)
+        lay |= np.eye(nB, dtype=bool)[None]
+        lay[:, :, 0] = True
+        layout = lay.astype(np.int32)
+        q = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((nH, S, D)), jnp.float32)
+        scale = 1.0 / math.sqrt(D)
+
+        def loss(w, q_w):
+            def f(q, k, v):
+                o = sparse_flash_attention(q, k, v, layout, causal=causal,
+                                           scale=scale, widen=w, qwiden=q_w)
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            return f
+
+        l1, g1 = jax.value_and_grad(loss(1, 1), argnums=(0, 1, 2))(q, k, v)
+        lw, gw = jax.value_and_grad(loss(kw, qw), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(lw), float(l1), rtol=1e-5)
+        for a, b, name in zip(gw, g1, "qkv"):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4,
+                                       err_msg=f"d{name} tile={qw}x{kw}")
+
+    def test_pick_tile_prefers_supertiles_on_banded_layouts(self):
+        from deepspeed_tpu.ops.sparse_flash import pick_tile
+        nB = 64
+        band = np.zeros((1, nB, nB), np.int32)
+        for i in range(nB):
+            band[0, i, max(0, i - 3): i + 1] = 1
+        band[0, :, 0] = 1
+        qw, kw = pick_tile(band, block=128)
+        assert qw * kw > 1, (qw, kw)   # fixed cost dominates 1x1 on bands
