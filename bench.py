@@ -32,11 +32,17 @@ def pick_model():
         # CE head are the perf-tuned settings (see ablate.py history).
         return dataclasses.replace(
             GPT2_CONFIGS["gpt2-large"], max_seq_length=1024,
-            # dots_flash: save the flash-attention (out, lse) residuals so
-            # remat's backward never re-runs the forward kernel; with the
-            # fused single-block backward this is worth ~4 TFLOPs (sweep:
-            # dots 99.5 vs dots_flash 103.5 on v5e).
-            remat_policy=os.environ.get("DS_BENCH_REMAT", "dots_flash"),
+            # Round-5 default: NO remat + master-free bf16 (DS_BENCH_SR).
+            # Stochastic rounding drops the fp32 masters AND the cast
+            # cache — exactly the HBM that lets remat=none fit at mbs=4 —
+            # and cuts optimizer traffic: 103.4 (dots_flash+masters) ->
+            # 108.1 TFLOPs on v5e. Each alone is ~noise (103.6/103.8);
+            # the memory synergy is the win. dots_flash remains the
+            # fp32-master setting (DS_BENCH_SR=0 flips remat back too).
+            remat_policy=os.environ.get(
+                "DS_BENCH_REMAT",
+                "none" if os.environ.get("DS_BENCH_SR", "1") == "1"
+                else "dots_flash"),
             hidden_dropout=0.0, attn_dropout=0.0,
             scan_layers=False), int(os.environ.get("DS_BENCH_MBS", "4"))
     return dataclasses.replace(
@@ -196,7 +202,14 @@ def main():
         "train_batch_size": micro_bs * n_chips,
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": 1,
-        "bf16": {"enabled": True},
+        # DS_BENCH_SR (default on): master-free bf16 with stochastic
+        # rounding — drops the fp32 master copy AND the separate
+        # cast-param cache, cutting optimizer-step HBM traffic (and
+        # freeing the memory the remat=none default needs). Convergence
+        # parity vs fp32 masters: tests/test_stochastic_rounding.py.
+        "bf16": {"enabled": True,
+                 "stochastic_rounding":
+                     os.environ.get("DS_BENCH_SR", "1") == "1"},
         "zero_optimization": {"stage": 2},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 10 ** 9,

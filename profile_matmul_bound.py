@@ -1,0 +1,126 @@
+"""Dev tool: how matmul-bound is the bench train step?
+
+Times a pure-GEMM replay of the training step's entire matmul schedule —
+per layer and per direction (fwd, dx, dw at their true shapes), the
+chunked-CE head's three GEMMs, and the actual flash-attention fwd+bwd
+kernels — and compares that floor against the measured end-to-end step.
+
+floor/step >= 0.90 means the remaining MFU gap is in the matmuls
+themselves (shape/tiling limits), not in elementwise work, the optimizer,
+or dispatch — the "provably done" criterion for the utilization ladder.
+Everything runs in one jitted lax.scan per timing (tunnel dispatch is
+~2.5 ms; see bench.py's sync note).
+
+Usage: python profile_matmul_bound.py [model] [mbs]
+"""
+import dataclasses
+import math
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token
+
+MODEL = sys.argv[1] if len(sys.argv) > 1 else "gpt2-large"
+MBS = int(sys.argv[2]) if len(sys.argv) > 2 else 4
+N = 8           # scan length per timing
+
+cfg = dataclasses.replace(GPT2_CONFIGS[MODEL], max_seq_length=1024)
+S, H, I, V = (cfg.max_seq_length, cfg.hidden_size,
+              cfg.intermediate_size, cfg.vocab_size)
+nH, D = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+L, BS = cfg.num_layers, MBS * cfg.max_seq_length
+key = jax.random.PRNGKey(0)
+
+
+def timed(fn, *args):
+    @jax.jit
+    def many(x, *rest):
+        def body(c, _):
+            out = fn(c, *rest)
+            # scalar feedback: serializes the scan AND keeps the full op
+            # live (a *0 feedback would be constant-folded away)
+            fb = jnp.sum(out.reshape(-1)[:1]).astype(c.dtype)
+            return c + fb * 1e-12, None
+        c, _ = jax.lax.scan(body, x, None, length=N)
+        return c
+    out = many(*args)
+    _ = float(jnp.sum(out.reshape(-1)[:1].astype(jnp.float32)))
+    t0 = time.perf_counter()
+    out = many(*args)
+    _ = float(jnp.sum(out.reshape(-1)[:1].astype(jnp.float32)))
+    return (time.perf_counter() - t0) / N * 1e3
+
+
+def gemm_ms(m, k, n):
+    """One [m,k]@[k,n] bf16 GEMM, timed in-scan."""
+    a = jax.random.normal(key, (m, k), jnp.bfloat16)
+    b = jax.random.normal(jax.random.fold_in(key, 1), (k, n), jnp.bfloat16)
+    return timed(lambda aa, bb: jnp.dot(aa, bb,
+                                        preferred_element_type=jnp.bfloat16),
+                 a, b)
+
+
+def linear_triple_ms(m, k, n):
+    """fwd [m,k]@[k,n] + dx [m,n]@[n,k] + dw [k,m]@[m,n]."""
+    return gemm_ms(m, k, n) + gemm_ms(m, n, k) + gemm_ms(k, m, n)
+
+
+def flash_ms():
+    from deepspeed_tpu.ops.flash_attention import flash_attention
+    q = jax.random.normal(key, (MBS * nH, S, D), jnp.bfloat16)
+
+    def fwd(qq):
+        return flash_attention(qq, q, q, causal=True,
+                               scale=1.0 / math.sqrt(D))
+
+    def fb(qq):
+        return jax.grad(lambda x: jnp.sum(
+            fwd(x).astype(jnp.float32) ** 2))(qq)
+
+    t_f = timed(lambda qq: fwd(qq)[:, 0], q)
+    t_fb = timed(lambda qq: fb(qq)[:, 0], q)
+    return t_f, t_fb
+
+
+def main():
+    print(f"{MODEL} mbs={MBS}: GEMM floor per train step", flush=True)
+    per_layer = (linear_triple_ms(BS, H, 3 * H)     # qkv
+                 + linear_triple_ms(BS, H, H)       # attn proj
+                 + linear_triple_ms(BS, H, I)       # fc1
+                 + linear_triple_ms(BS, I, H))      # fc2
+    t_head = linear_triple_ms(BS, H, V)             # chunked-CE GEMMs
+    t_attn_f, t_attn_fb = flash_ms()
+    # remat "dots_flash" saves flash residuals: attention cost = fwd + the
+    # fused bwd pass (which internally replays fwd once) = t_attn_fb.
+    floor = per_layer * L + t_head + t_attn_fb * L
+    print(f"  linear GEMMs x{L}: {per_layer * L:7.1f} ms "
+          f"({per_layer:.3f}/layer)", flush=True)
+    print(f"  CE-head GEMMs   : {t_head:7.1f} ms", flush=True)
+    print(f"  flash attn x{L}  : {t_attn_fb * L:7.1f} ms "
+          f"(fwd alone {t_attn_f * L:.1f})", flush=True)
+    print(f"  GEMM floor      : {floor:7.1f} ms", flush=True)
+
+    achieved_ms = None
+    if len(sys.argv) > 3:
+        achieved_ms = float(sys.argv[3])
+    else:
+        tok_s = 19915.0    # BENCH r5 measurement (update when re-run)
+        achieved_ms = MBS * S / tok_s * 1e3
+    ratio = floor / achieved_ms
+    flops = gpt2_flops_per_token(cfg, S) * MBS * S
+    print(f"  achieved step   : {achieved_ms:7.1f} ms "
+          f"({flops / achieved_ms / 1e9:.1f} TFLOPs)", flush=True)
+    print(f"  floor MFU       : {flops / floor / 1e9:7.1f} TFLOPs if "
+          f"matmuls alone", flush=True)
+    print(f"  matmul-bound ratio: {ratio:.2f} "
+          f"({'>=0.90: matmul-bound' if ratio >= 0.9 else 'gap is non-GEMM work'})",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
