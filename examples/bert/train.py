@@ -13,6 +13,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import jax
 import numpy as np
 
+# Honor JAX_PLATFORMS from the environment: the TPU-harness sitecustomize
+# force-sets the platform at startup, so the env var alone is ignored —
+# required for running these scripts on the virtual CPU mesh (CI).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import deepspeed_tpu
 from deepspeed_tpu.models.bert import (BERT_CONFIGS, bert_init,
                                        bert_mlm_loss_fn)
@@ -40,7 +46,6 @@ def main():
     cfg = BERT_CONFIGS[args.model]
     ds_config = {
         "train_batch_size": 8,
-        "train_micro_batch_size_per_gpu": 8,
         "gradient_accumulation_steps": 1,
         "bf16": {"enabled": True},
         "zero_optimization": {"stage": 1},

@@ -16,6 +16,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# Honor JAX_PLATFORMS from the environment: the TPU-harness sitecustomize
+# force-sets the platform at startup, so the env var alone is ignored —
+# required for running these scripts on the virtual CPU mesh (CI).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import deepspeed_tpu
 
 
@@ -63,7 +69,6 @@ def main():
 
     config = args.deepspeed_config or {
         "train_batch_size": 64,
-        "train_micro_batch_size_per_gpu": 64,
         "gradient_accumulation_steps": 1,
         "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
         "scheduler": {"type": "WarmupLR", "params": {"warmup_num_steps": 20}},

@@ -19,6 +19,12 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 import jax
 import numpy as np
 
+# Honor JAX_PLATFORMS from the environment: the TPU-harness sitecustomize
+# force-sets the platform at startup, so the env var alone is ignored —
+# required for running these scripts on the virtual CPU mesh (CI).
+if os.environ.get("JAX_PLATFORMS"):
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import deepspeed_tpu
 from deepspeed_tpu.models import GPT2_CONFIGS, gpt2_init, gpt2_loss_fn
 
