@@ -216,6 +216,81 @@ def _sdq_kernel(qid_ref, kid_ref, nnz_ref, kmask_ref, q_ref, k_ref, v_ref,
         dq_ref[0] = acc_scr[:].astype(dq_ref.dtype)
 
 
+def _sfused_bwd_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref,
+                       k_ref, v_ref, do_ref, lse_ref, delta_ref, seed_ref,
+                       dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *, scale,
+                       causal, bq, bk, nH, dropout, widen, qwiden):
+    """Fused backward: ONE column-major pass emits dk, dv AND per-step dq
+    partials (segment-summed by q-row outside the kernel). Compared to
+    the split dq+dkv pair this computes s/p/dp/ds once instead of twice —
+    the per-block cost that dominates at head-dim 64 — and drops a whole
+    kernel's per-step fixed cost. The dense kernels' fused whole-S
+    backward is the same idea; here the partial-sum trick stands in for
+    whole-S row coverage (a k-column's steps touch arbitrary q rows, so
+    dq cannot be accumulated in scratch across them)."""
+    bh, n = pl.program_id(0), pl.program_id(1)
+    h = bh % nH
+    kj = kidT_ref[h, n]
+    qi = qidT_ref[h, n]
+    prev_kj = kidT_ref[h, jnp.maximum(n - 1, 0)]
+    new_col = jnp.logical_or(n == 0, kj != prev_kj)
+    active = n < nnzT_ref[h]
+
+    @pl.when(jnp.logical_and(new_col, active))
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    @pl.when(active)
+    def _compute():
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0, 0][None, :]
+        delta = delta_ref[0, 0][None, :]
+        s2 = jax.lax.dot_general(
+            k, q, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s2 = _causal_mask(s2, qi, kj, bq, bk, transposed=True)
+        s2 = _submask(s2, kmaskT_ref[h, n], bq, bk, qwiden, widen,
+                      transposed=True)
+        p2 = jnp.exp(s2 - lse)
+        if dropout > 0.0:
+            keep2 = _dropout_keep(seed_ref[0, 0], bh, qi, kj, bq, bk,
+                                  dropout, transposed=True)
+            inv = 1.0 / (1.0 - dropout)
+            p2_drop = jnp.where(keep2, p2 * inv, 0.0)
+        else:
+            p2_drop = p2
+        dv_scr[:] += jax.lax.dot_general(
+            p2_drop.astype(do.dtype), do, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp2 = jax.lax.dot_general(
+            v, do, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        if dropout > 0.0:
+            dp2 = jnp.where(keep2, dp2 * inv, 0.0)
+        ds2 = p2 * (dp2 - delta) * scale
+        dk_scr[:] += jax.lax.dot_general(
+            ds2.astype(q.dtype), q, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dq partial for THIS step's q rows: ds^T @ k, shipped per step
+        # (garbage on inactive tail steps is routed to a dump segment by
+        # the host-built segment ids, never summed into a real row).
+        dqp_ref[0, 0] = jax.lax.dot_general(
+            ds2.astype(k.dtype), k, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).astype(dqp_ref.dtype)
+
+    nj = pl.num_programs(1)
+    next_kj = kidT_ref[h, jnp.minimum(n + 1, nj - 1)]
+    col_last = jnp.logical_or(n == nnzT_ref[h] - 1,
+                              jnp.logical_and(active, next_kj != kj))
+
+    @pl.when(col_last)
+    def _store():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
 def _sdkv_kernel(kidT_ref, qidT_ref, nnzT_ref, kmaskT_ref, q_ref, k_ref,
                  v_ref, do_ref, lse_ref, delta_ref, seed_ref, dk_ref, dv_ref,
                  dk_scr, dv_scr, *, scale, causal, bq, bk, nH, dropout,
@@ -326,8 +401,93 @@ def _sparse_fwd(q, k, v, qid, kid, nnz, kmask, seed, scale, causal, nH, bq,
     return o, lse
 
 
+def _sparse_bwd_fused(q, k, v, o, lse, do, luts, seed, scale, causal, nH,
+                      bq, bk, dropout, widen, qwiden):
+    """One column-major pass for dk+dv+dq-partials, then a scatter-add
+    over q rows. Vs the split dq+dkv pair: s/p/dp/ds computed once per
+    block instead of twice, and one kernel's per-step fixed cost gone."""
+    qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT = luts
+    BH, S, D = q.shape
+    NNZT = kidT.shape[-1]
+    nQ2 = S // bq
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH,1,S]
+
+    dk, dv, dqp = pl.pallas_call(
+        functools.partial(_sfused_bwd_kernel, scale=scale, causal=causal,
+                          bq=bq, bk=bk, nH=nH, dropout=dropout, widen=widen,
+                          qwiden=qwiden),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=4,
+            grid=(BH, NNZT),
+            in_specs=[
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, qi[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bq, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, qi[b % nH, n], 0)),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, 0, qi[b % nH, n])),
+                pl.BlockSpec((1, 1, bq),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, 0, qi[b % nH, n])),
+                pl.BlockSpec(memory_space=pltpu.SMEM),
+            ],
+            out_specs=[
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, bk, D),
+                             lambda b, n, ki, qi, nz, km:
+                             (b, ki[b % nH, n], 0)),
+                pl.BlockSpec((1, 1, bq, D),
+                             lambda b, n, ki, qi, nz, km: (b, n, 0, 0)),
+            ],
+            scratch_shapes=[
+                pltpu.VMEM((bk, D), jnp.float32),
+                pltpu.VMEM((bk, D), jnp.float32),
+            ]),
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, k.shape[1], D), k.dtype),
+            jax.ShapeDtypeStruct((BH, v.shape[1], D), v.dtype),
+            jax.ShapeDtypeStruct((BH, NNZT, bq, D), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(kidT, qidT, nnzT, kmaskT, q, k, v, do, lse, delta, seed)
+
+    # Route each step's dq partial to its q row; tail steps (n >= nnzT[h],
+    # whose dqp blocks are unwritten garbage) go to a dump segment.
+    steps = jnp.arange(NNZT)[None, :]                       # [1, NNZT]
+    ids = jnp.where(steps < nnzT[:, None], qidT, nQ2)       # [nH, NNZT]
+
+    def seg(dqp_bh, ids_h):
+        out = jnp.zeros((nQ2 + 1, bq, D), jnp.float32)
+        return out.at[ids_h].add(dqp_bh)[:nQ2]
+
+    dq = jax.vmap(seg)(dqp, ids[jnp.arange(BH) % nH])
+    return dq.reshape(BH, S, D).astype(q.dtype), dk, dv
+
+
 def _sparse_bwd(q, k, v, o, lse, do, luts, seed, scale, causal, nH, bq, bk,
                 dropout, widen, qwiden):
+    # DS_SPARSE_FUSED_BWD=1 opts into the fused single-pass backward.
+    # Measured v5e (S=32768, d=0.023): fused 16.7/15.5 ms (q2k4/q1k4) vs
+    # split 15.2/16.2 — the f32 dq-partials traffic + segment scatter
+    # offsets the saved s/p recompute, so the split pair stays default.
+    # Kept because the balance flips where HBM is faster relative to the
+    # per-step fixed cost (larger D, future chips).
+    import os
+    if os.environ.get("DS_SPARSE_FUSED_BWD", "0") == "1":
+        return _sparse_bwd_fused(q, k, v, o, lse, do, luts, seed, scale,
+                                 causal, nH, bq, bk, dropout, widen, qwiden)
     qid, kid, nnz, kmask, qidT, kidT, nnzT, kmaskT = luts
     BH, S, D = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
