@@ -1306,11 +1306,16 @@ class DeepSpeedEngine:
                 mean_loss = mean_loss.astype(jnp.float32)
             elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
-                # add pass over the fp32 grad tree every step.
+                # add pass over the fp32 grad tree every step. Master-free
+                # mode keeps the grads in their born bf16: the optimizer
+                # math promotes per-op to its f32 moments anyway, and the
+                # f32 grad round-trip is a full extra pass over HBM.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
                 (_, raw_loss), grads = grad_fn(loss_params, mb, keys[0],
                                                scale, theta)
-                grads = constrain_grads(_cast_floats(grads, jnp.float32))
+                grads = constrain_grads(
+                    grads if master_free
+                    else _cast_floats(grads, jnp.float32))
                 mean_loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
