@@ -51,6 +51,12 @@ def test_gpt2_example_zero2():
     assert loss > 0.0
 
 
+def test_gpt2_example_onebit():
+    loss = run_example("examples/gpt2/train.py",
+                       "--config", "ds_config_onebit.json", "--steps", "12")
+    assert loss > 0.0
+
+
 def test_gpt2_example_pipeline_1f1b():
     loss = run_example("examples/gpt2/train.py",
                        "--config", "ds_config_pipeline.json",
