@@ -211,7 +211,14 @@ def main():
                  "stochastic_rounding":
                      os.environ.get("DS_BENCH_SR", "1") == "1"},
         "zero_optimization": {"stage": 2},
-        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        # DS_BENCH_FUSED (default on): single-pass Pallas multi-tensor
+        # optimizer apply (ops/fused_update.py) — one HBM pass over
+        # grad+param+m+v with clip + SR folded in, vs the optax chain's
+        # per-leaf fusions. Parity: tests/test_fused_update.py; apply-only
+        # delta: ablate_fused_update.py.
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-4, "fused": os.environ.get(
+                          "DS_BENCH_FUSED", "1") == "1"}},
         "steps_per_print": 10 ** 9,
     }
     engine = DeepSpeedEngine(model=gpt2_loss_fn(cfg), model_params=params,
@@ -258,6 +265,8 @@ def main():
         "unit": f"TFLOPs/chip (bf16, {n_chips} chip(s), "
                 f"{tokens_per_sec:,.0f} tok/s, {frac_peak:.1%} of peak)",
         "vs_baseline": round(frac_peak / ref_frac, 3),
+        # Ladder provenance: which optimizer apply produced this number.
+        "fused_optimizer_apply": ds_config["optimizer"]["params"]["fused"],
     }
     if jax.devices()[0].platform == "tpu":
         # Free the headline engine's HBM first (a live offload run needs it).

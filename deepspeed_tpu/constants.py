@@ -39,6 +39,12 @@ TYPE = "type"
 LEGACY_FUSION = "legacy_fusion"
 LEGACY_FUSION_DEFAULT = False
 
+# optimizer.params.fused: route Adam/AdamW through the single-pass Pallas
+# multi-tensor apply (ops/fused_update.py). On by default where parity
+# holds; false restores the optax chain.
+OPTIMIZER_FUSED = "fused"
+OPTIMIZER_FUSED_DEFAULT = True
+
 SCHEDULER = "scheduler"
 SCHEDULER_TYPE_DEFAULT = None
 SCHEDULER_PARAMS = "params"

@@ -1,0 +1,343 @@
+"""Fused Pallas multi-tensor optimizer apply.
+
+Parity target: the reference's multi-tensor fused Adam
+(``csrc/adam/multi_tensor_adam.cu:123``) — ONE kernel pass per chunk that
+reads grad+param+m+v and writes param+m+v, with the chunked multi-tensor
+front end amortizing thousands of small leaves into a handful of launches.
+
+Why this exists on TPU at all (given XLA already fuses elementwise ops):
+XLA fuses *within* a leaf, but the optax apply is still one fusion per
+param leaf — ~450 kernel launches for an unrolled GPT-2, each re-paying
+launch + pipeline-warmup overhead — and the engine's clip multiply,
+unscale, bias correction and stochastic-rounding write are separate
+HBM passes when XLA's fusion heuristics split them. The Pallas kernel
+makes the single-pass property structural instead of heuristic:
+
+    read  grad, param, m, v          (one chunk per grid step, VMEM)
+    g  = grad * clip_coeff           (global-clip folded in, no clip pass)
+    m' = (1-b1)*g + b1*m             (f32, even for bf16 grads — the
+    v' = (1-b2)*g^2 + b2*v            second moment is never squared in
+                                      bf16; reference fp32 accumulators)
+    u  = -lr * (m'/bc1 / (sqrt(v'/bc2) + eps) + wd*p)
+    write param+u (optionally via unbiased stochastic rounding to bf16
+    — the master-free mode of ops/stochastic_rounding.py, done in-kernel
+    from a hash-counter PRNG so no noise tensor ever touches HBM), m', v'
+
+The multi-tensor front end flattens the pytree's float leaves into
+contiguous same-dtype chunk buffers (the moral equivalent of the CUDA
+chunked apply); the optimizer state stores the moments *already fused*
+(one f32 buffer per dtype group), so only grads/params pay the
+flatten/unflatten passes.
+
+The deterministic path is bit-exact with ``optax.adamw`` / the engine's
+coupled-Adam chain: every multiply-add is written in optax's association
+order (see ``tests/test_fused_update.py``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU backend bits are importable everywhere; interpret=True runs on CPU
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+ScheduleOrFloat = Union[Callable, float]
+
+# Chunk geometry: W lanes wide (128-multiple), R sublane rows per grid
+# step. One (R, W) f32 block is 512 KiB; with 4 inputs + 3 outputs double
+# buffered that is ~7 MiB of VMEM — inside the ~16 MiB/core budget.
+_W = 1024
+_R = 128
+_CHUNK = _R * _W   # elements per grid step; buffers pad to a multiple
+
+
+class FusedAdamState(NamedTuple):
+    """Fused optimizer state: one f32 moment buffer per dtype group.
+
+    The moments live *pre-flattened* — only grads and params pay the
+    per-step flatten/unflatten. Buffers are padded to a _CHUNK multiple,
+    which keeps them divisible by any practical dp size so ZeRO
+    shardings (zero/partition.py) split them on axis 0 and checkpoint
+    shards stay elastic across dp resizes.
+    """
+    count: jax.Array                 # int32 scalar, number of updates
+    m: Tuple[jax.Array, ...]
+    v: Tuple[jax.Array, ...]
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _float_groups(leaves):
+    """Deterministic dtype-grouping of float leaves: [(dtype, [leaf idx])],
+    sorted by dtype name. Non-float leaves bypass the kernel entirely."""
+    groups = {}
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype,
+                                                     jnp.floating):
+            groups.setdefault(jnp.dtype(leaf.dtype), []).append(i)
+    return sorted(groups.items(), key=lambda kv: kv[0].name)
+
+
+def _pad_to_chunk(n: int) -> int:
+    return max(_CHUNK, ((n + _CHUNK - 1) // _CHUNK) * _CHUNK)
+
+
+def _flatten_group(leaves, idxs, dtype, npad: int) -> jax.Array:
+    flats = [leaves[i].reshape(-1).astype(dtype) for i in idxs]
+    n = sum(f.size for f in flats)
+    if npad > n:
+        flats.append(jnp.zeros((npad - n,), dtype))
+    return jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+
+
+def _hash_u32(x: jax.Array) -> jax.Array:
+    """murmur3 finalizer: a stateless counter hash good enough for the
+    rounding noise (16 low bits used), identical on TPU and interpret."""
+    x = x ^ (x >> jnp.uint32(16))
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> jnp.uint32(13))
+    x = x * jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> jnp.uint32(16))
+
+
+def _fused_adam_kernel(scal_ref, seed_ref, g_ref, p_ref, m_ref, v_ref,
+                       p_out, m_out, v_out, *, b1: float, b2: float,
+                       eps: float, wd: float, coupled: bool,
+                       scale_grads: bool, sr: bool, out_dtype):
+    """One chunk of the fused apply. scal_ref (SMEM, f32 [1,4]):
+    [neg_lr, bias_corr1, bias_corr2, grad_scale]; seed_ref (SMEM, int32
+    [1,1]): stochastic-rounding seed. Math follows optax's association
+    order exactly (bit parity on the deterministic path)."""
+    g = g_ref[...].astype(jnp.float32)
+    if scale_grads:
+        g = g * scal_ref[0, 3]
+    p32 = p_ref[...].astype(jnp.float32)
+    if coupled and wd:
+        # Classic (coupled L2) Adam: decay folded into the gradient
+        # BEFORE the moment update (optax.add_decayed_weights first in
+        # the chain; reference FusedAdam adam_w_mode=False).
+        g = g + wd * p32
+    m = (1 - b1) * g + b1 * m_ref[...]
+    v = (1 - b2) * (g * g) + b2 * v_ref[...]
+    u = (m / scal_ref[0, 1]) / (jnp.sqrt(v / scal_ref[0, 2]) + eps)
+    if (not coupled) and wd:
+        u = u + wd * p32
+    new_p = p32 + u * scal_ref[0, 0]
+    m_out[...] = m
+    v_out[...] = v
+    if sr:
+        # In-kernel unbiased stochastic rounding to bf16 (the master-free
+        # mode): add uniform 16-bit noise to the f32 mantissa tail, then
+        # truncate — E[round(x)] == x (see ops/stochastic_rounding.py).
+        # Noise comes from a counter hash of the global element index, so
+        # it costs zero HBM traffic and is reproducible per (seed, index).
+        R, W = new_p.shape
+        rows = lax.broadcasted_iota(jnp.uint32, (R, W), 0)
+        cols = lax.broadcasted_iota(jnp.uint32, (R, W), 1)
+        idx = (pl.program_id(0).astype(jnp.uint32) * jnp.uint32(R) + rows) \
+            * jnp.uint32(W) + cols
+        noise = _hash_u32(idx ^ seed_ref[0, 0].astype(jnp.uint32)) \
+            & jnp.uint32(0xFFFF)
+        bits = lax.bitcast_convert_type(new_p, jnp.uint32)
+        rounded = (bits + noise) & jnp.uint32(0xFFFF0000)
+        out = lax.bitcast_convert_type(rounded, jnp.float32) \
+            .astype(jnp.bfloat16)
+        # inf/nan must stay put (the carry could walk an inf into nan
+        # space); overflow handling belongs to the loss-scale machinery.
+        p_out[...] = jnp.where(jnp.isfinite(new_p), out,
+                               new_p.astype(jnp.bfloat16))
+    else:
+        p_out[...] = new_p.astype(out_dtype)
+
+
+def _smem_spec(shape):
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec(memory_space=pltpu.SMEM)
+    return pl.BlockSpec(shape, lambda i: (0, 0))
+
+
+def _chunk_spec():
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec((_R, _W), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((_R, _W), lambda i: (i, 0))
+
+
+def _run_group(gflat, pflat, m, v, scalars, seed, *, b1, b2, eps, wd,
+               coupled, scale_grads, sr, out_dtype):
+    """Run the kernel over one fused dtype-group buffer [Npad]."""
+    npad = gflat.size
+    rows = npad // _W
+    shape2 = (rows, _W)
+    kernel = functools.partial(
+        _fused_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd, coupled=coupled,
+        scale_grads=scale_grads, sr=sr, out_dtype=out_dtype)
+    p_new, m_new, v_new = pl.pallas_call(
+        kernel,
+        grid=(rows // _R,),
+        in_specs=[_smem_spec((1, 4)), _smem_spec((1, 1)),
+                  _chunk_spec(), _chunk_spec(), _chunk_spec(),
+                  _chunk_spec()],
+        out_specs=[_chunk_spec(), _chunk_spec(), _chunk_spec()],
+        out_shape=[
+            jax.ShapeDtypeStruct(shape2, out_dtype),
+            jax.ShapeDtypeStruct(shape2, jnp.float32),
+            jax.ShapeDtypeStruct(shape2, jnp.float32),
+        ],
+        # In-place update: p/m/v inputs alias the outputs (same
+        # shape+dtype when the param dtype matches; m/v always), so the
+        # kernel never holds two copies of the moments in HBM.
+        input_output_aliases=(
+            {3: 0, 4: 1, 5: 2} if pflat.dtype == out_dtype
+            else {4: 1, 5: 2}),
+        interpret=_interpret(),
+    )(scalars, seed, gflat.reshape(shape2), pflat.reshape(shape2),
+      m.reshape(shape2), v.reshape(shape2))
+    return p_new.reshape(-1), m_new.reshape(-1), v_new.reshape(-1)
+
+
+def fused_adam(learning_rate: ScheduleOrFloat, b1: float = 0.9,
+               b2: float = 0.999, eps: float = 1e-8,
+               weight_decay: float = 0.0, adam_w_mode: bool = True,
+               multi_tensor: bool = True) -> "FusedGradientTransformation":
+    """Build the fused-apply transformation.
+
+    ``adam_w_mode=True`` matches ``optax.adamw`` (decoupled decay);
+    ``False`` matches the engine's coupled-L2 chain (decay folded into
+    the gradient before the moments). ``multi_tensor=False`` runs one
+    kernel launch per leaf instead of chunked fused buffers — kept for
+    the ablation ladder (``ablate_fused_update.py``), not production.
+
+    Returned object is optax-compatible (``init``/``update``) and carries
+    the single-pass entry point ``fused_apply(grads, state, params,
+    clip_coeff=None, sr_key=None) -> (new_params, new_state)`` that the
+    engine's train steps call directly: it folds the global-clip
+    coefficient into the kernel (no separate clip pass) and, given
+    ``sr_key``, rounds bf16 params stochastically in-kernel.
+    """
+    sched = learning_rate if callable(learning_rate) else None
+    base_lr = None if sched is not None else float(learning_rate)
+
+    def _leaves(params):
+        return jax.tree_util.tree_flatten(params)
+
+    def init_fn(params):
+        leaves, _ = _leaves(params)
+        groups = _float_groups(leaves)
+        bufs = []
+        for _, idxs in groups:
+            n = sum(int(leaves[i].size) for i in idxs)
+            npad = _pad_to_chunk(n) if multi_tensor else None
+            if multi_tensor:
+                bufs.append(jnp.zeros((npad,), jnp.float32))
+            else:
+                # per-leaf mode: one moment buffer per leaf, each padded
+                # to a whole chunk (tiny leaves burn a full chunk — the
+                # launch-amortization problem multi-tensor mode fixes).
+                bufs.append(tuple(
+                    jnp.zeros((_pad_to_chunk(int(leaves[i].size)),),
+                              jnp.float32) for i in idxs))
+        return FusedAdamState(count=jnp.zeros([], jnp.int32),
+                              m=tuple(bufs),
+                              v=jax.tree_util.tree_map(jnp.zeros_like,
+                                                       tuple(bufs)))
+
+    def _scalars(count, clip_coeff):
+        count_inc = count + 1
+        # Bit parity: these are the exact expressions optax evaluates
+        # (python-float ** int32 array → f32 power; see
+        # optax.tree_utils.tree_bias_correction).
+        bc1 = (1 - b1 ** count_inc).astype(jnp.float32)
+        bc2 = (1 - b2 ** count_inc).astype(jnp.float32)
+        lr = sched(count) if sched is not None else base_lr
+        neg_lr = jnp.asarray(-1.0, jnp.float32) * jnp.asarray(
+            lr, jnp.float32)
+        gscale = jnp.asarray(1.0, jnp.float32) if clip_coeff is None \
+            else jnp.asarray(clip_coeff, jnp.float32)
+        return jnp.stack([neg_lr, bc1, bc2, gscale]).reshape(1, 4), count_inc
+
+    def _apply(grads, state, params, clip_coeff=None, sr_key=None):
+        if params is None:
+            raise ValueError("fused_adam requires params")
+        p_leaves, treedef = _leaves(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        groups = _float_groups(p_leaves)
+        scalars, count_inc = _scalars(state.count, clip_coeff)
+        seed0 = jax.random.bits(sr_key, (), jnp.uint32).astype(jnp.int32) \
+            if sr_key is not None else jnp.zeros((), jnp.int32)
+        new_leaves = list(p_leaves)
+        new_m, new_v = [], []
+        for gi, (dt, idxs) in enumerate(groups):
+            sr = sr_key is not None and dt == jnp.dtype(jnp.bfloat16)
+            seed = (seed0 + jnp.int32(gi)).reshape(1, 1)
+            run = functools.partial(
+                _run_group, scalars=scalars, seed=seed, b1=b1, b2=b2,
+                eps=eps, wd=weight_decay, coupled=not adam_w_mode,
+                scale_grads=clip_coeff is not None, sr=sr, out_dtype=dt)
+            if multi_tensor:
+                sizes = [int(p_leaves[i].size) for i in idxs]
+                npad = _pad_to_chunk(sum(sizes))
+                # Grads flatten in f32, NOT the param dtype: master-free
+                # engines hand in f32-accumulated grads over bf16 params,
+                # and truncating them here would defeat the kernel's
+                # f32-second-moment guarantee before it ever reads them.
+                pflat, mn, vn = run(
+                    _flatten_group(g_leaves, idxs, jnp.float32, npad),
+                    _flatten_group(p_leaves, idxs, dt, npad),
+                    state.m[gi], state.v[gi])
+                off = 0
+                for i, sz in zip(idxs, sizes):
+                    new_leaves[i] = \
+                        pflat[off:off + sz].reshape(p_leaves[i].shape)
+                    off += sz
+                new_m.append(mn)
+                new_v.append(vn)
+            else:
+                ms, vs = [], []
+                for j, i in enumerate(idxs):
+                    sz = int(p_leaves[i].size)
+                    npad = _pad_to_chunk(sz)
+                    pf, mn, vn = run(
+                        _flatten_group(g_leaves, [i], jnp.float32, npad),
+                        _flatten_group(p_leaves, [i], dt, npad),
+                        state.m[gi][j], state.v[gi][j])
+                    new_leaves[i] = pf[:sz].reshape(p_leaves[i].shape)
+                    ms.append(mn)
+                    vs.append(vn)
+                new_m.append(tuple(ms))
+                new_v.append(tuple(vs))
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        return new_params, FusedAdamState(count=count_inc, m=tuple(new_m),
+                                          v=tuple(new_v))
+
+    def update_fn(updates, state, params=None):
+        """optax-compatible wrapper: returns delta-style updates so generic
+        callers (``optax.apply_updates``) keep working. The engine's train
+        steps call ``fused_apply`` instead for the true single-pass write."""
+        new_params, new_state = _apply(updates, state, params)
+        deltas = jax.tree_util.tree_map(
+            lambda np_, p: (np_.astype(jnp.float32) -
+                            p.astype(jnp.float32)).astype(np_.dtype)
+            if hasattr(p, "dtype") and jnp.issubdtype(p.dtype, jnp.floating)
+            else jnp.zeros_like(p) if hasattr(p, "dtype") else p,
+            new_params, params)
+        return deltas, new_state
+
+    return FusedGradientTransformation(init=init_fn, update=update_fn,
+                                       fused_apply=_apply)
+
+
+class FusedGradientTransformation(NamedTuple):
+    """optax.GradientTransformation duck-type + the fused entry point."""
+    init: Callable[[Any], FusedAdamState]
+    update: Callable[..., Tuple[Any, FusedAdamState]]
+    fused_apply: Callable[..., Tuple[Any, FusedAdamState]]

@@ -3,10 +3,12 @@
 Parity with reference ``runtime/engine.py:588-628`` (Adam/AdamW → fused or
 CPU variant, Lamb → FusedLamb, OneBitAdam, arbitrary torch optimizers) and
 the op-level optimizers ``ops/adam/fused_adam.py``, ``ops/lamb/
-fused_lamb.py``. On TPU, XLA fuses the elementwise optimizer math into a
-handful of kernels on its own — the "fused" quality the reference gets from
-hand-written CUDA (csrc/adam/multi_tensor_adam.cu) is the default here, so
-these build on optax transforms; the ds_config param names are translated.
+fused_lamb.py``. The Adam family defaults to the Pallas single-pass
+multi-tensor apply (ops/fused_update.py — the structural equivalent of
+csrc/adam/multi_tensor_adam.cu); ``optimizer.params.fused=false`` restores
+the optax chain, whose elementwise math XLA fuses per leaf on its own.
+Everything else builds on optax transforms; ds_config param names are
+translated.
 
 ``onebitadam`` runs standard Adam in its warmup phase; the compressed
 communication variant lives in ``ops/onebit.py`` (engaged via config).
@@ -73,6 +75,15 @@ def build_optimizer(name: str, params: Dict[str, Any],
         if name == C.ONEBIT_ADAM_OPTIMIZER:
             logger.info("OnebitAdam: uncompressed warmup uses standard Adam; "
                         "compressed collectives engage via ops.onebit")
+        elif params.get(C.OPTIMIZER_FUSED, C.OPTIMIZER_FUSED_DEFAULT):
+            # Single-pass Pallas multi-tensor apply (the reference's
+            # csrc/adam/multi_tensor_adam.cu equivalent). optax-compatible
+            # (init/update); the engine's train steps call its fused_apply
+            # for the clip-folded single-HBM-pass write.
+            from .fused_update import fused_adam
+            return fused_adam(learning_rate, b1=betas[0], b2=betas[1],
+                              eps=eps, weight_decay=weight_decay,
+                              adam_w_mode=adam_w_mode)
         if adam_w_mode:
             return optax.adamw(learning_rate, b1=betas[0], b2=betas[1], eps=eps,
                                weight_decay=weight_decay)

@@ -37,6 +37,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..parallel import comm
 from ..parallel.topology import SP_AXIS
 
 NEG_INF = -1e30
@@ -97,7 +98,7 @@ def _ring_attention_local(q, k, v, *, scale: float, causal: bool,
         return (acc, m, l, kc, vc), None
 
     # Carries must be marked varying-over-seq like the data they merge with.
-    vary = lambda x: lax.pcast(x, axis_name, to="varying")
+    vary = lambda x: comm.pvary(x, axis_name)
     acc0 = vary(jnp.zeros((B, S_loc, nH, D), jnp.float32))
     m0 = vary(jnp.full((B, nH, S_loc), NEG_INF / 2, jnp.float32))
     l0 = vary(jnp.zeros((B, nH, S_loc), jnp.float32))
@@ -130,7 +131,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     # partitions them outside the manual region), so the specs mention
     # ONLY the manual axis.
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = comm.shard_map(
         partial(_ring_attention_local, scale=scale, causal=causal,
                 sp=sp, axis_name=axis_name),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

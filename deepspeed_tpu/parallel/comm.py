@@ -29,6 +29,54 @@ from ..utils.logging import logger
 _INITIALIZED = False
 
 
+def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
+              axis_names=None, **kw):
+    """Version-portable ``shard_map``: newer jax exposes ``jax.shard_map``
+    (kwargs ``check_vma`` and ``axis_names`` = the manual axes); older
+    releases ship it under ``jax.experimental.shard_map`` where the same
+    knobs are ``check_rep`` and the complementary ``auto`` set. Every
+    in-repo caller routes through here."""
+    if hasattr(jax, "shard_map"):
+        if check_vma is not None:
+            kw["check_vma"] = check_vma
+        if axis_names is not None:
+            kw["axis_names"] = axis_names
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    # Older jax's replication checker (check_rep) predates pcast/pvary, so
+    # kernels that mark varying carries with the new API can never satisfy
+    # it — disable it by default there (it is a static analysis only).
+    kw["check_rep"] = bool(check_vma) if check_vma is not None else False
+    if axis_names is not None:
+        auto = {a for a in frozenset(mesh.axis_names) - frozenset(axis_names)
+                if mesh.shape[a] > 1}
+        if auto:
+            # Partial-auto (manual pipe/seq axis + GSPMD dp/mp inside) is
+            # where old-jax support ends: its experimental `auto=` path
+            # CHECK-fails in XLA on these programs. Fail with a real
+            # message instead of aborting the interpreter.
+            raise NotImplementedError(
+                f"this jax ({jax.__version__}) cannot run a partially-"
+                f"manual shard_map (manual {sorted(axis_names)} + auto "
+                f"{sorted(auto)} axes); upgrade jax or set the auto axes "
+                "to size 1")
+        # All residual axes are size 1: run fully manual (equivalent).
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      **kw)
+
+
+def pvary(x, axis_name):
+    """Mark ``x`` as varying over a manual mesh axis. New jax spells this
+    ``lax.pcast(..., to="varying")``; older releases have no such marking
+    (their shard_map rep-checker is disabled above), so it is identity."""
+    if hasattr(lax, "pcast"):
+        return lax.pcast(x, axis_name, to="varying")
+    if hasattr(lax, "pvary"):
+        return lax.pvary(x, axis_name)
+    return x
+
+
 def init_distributed(dist_backend: str = "xla", distributed_port: int = 29500,
                      verbose: bool = True, init_method: Optional[str] = None) -> None:
     """Bring up the multi-host JAX runtime if env says we're multi-process.

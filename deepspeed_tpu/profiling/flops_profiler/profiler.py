@@ -215,7 +215,11 @@ def _module_path(eqn, max_depth: int = 12) -> Tuple[str, ...]:
     try:
         frames = list(source_info_util.user_frames(tb))
     except Exception:
-        return ()
+        try:
+            # Older jax: user_frames takes the SourceInfo, not a Traceback.
+            frames = list(source_info_util.user_frames(eqn.source_info))
+        except Exception:
+            return ()
     frames = list(reversed(frames))               # outermost first
     # Drop the harness: everything up to (and including) the innermost frame
     # inside this file — pytest/runpy/engine frames above profile_fn are not

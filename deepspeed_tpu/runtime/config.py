@@ -215,6 +215,23 @@ class DeepSpeedConfig:
         amp = d.get(C.AMP, {})
         self.amp_enabled = get(amp, C.AMP_ENABLED, C.AMP_ENABLED_DEFAULT)
         self.amp_params = {k: v for k, v in amp.items() if k != C.AMP_ENABLED}
+        # amp acts or raises — silent-ignore is the one unacceptable state
+        # (reference engine.py:630-668 wraps apex amp). On TPU the amp
+        # semantic (mixed-precision compute, fp32 masters) IS the bf16
+        # path, so "amp": {"enabled": true} maps onto it with a notice;
+        # combined with fp16 it raises instead of guessing.
+        if self.amp_enabled:
+            if self.fp16_enabled:
+                raise DeepSpeedConfigError(
+                    "amp and fp16 cannot both be enabled: on TPU amp maps "
+                    "to the bf16 mixed-precision path — pick `bf16` (or "
+                    "`amp` alone) or `fp16`")
+            if not self.bf16_enabled:
+                self.bf16_enabled = True
+                logger.info(
+                    "amp: enabled -> mapped to the bf16 mixed-precision "
+                    "path (TPU has no apex; bf16 is the amp-equivalent "
+                    "O1 mode). Set bf16.enabled directly to silence this.")
 
         self.gradient_clipping = get(d, C.GRADIENT_CLIPPING, C.GRADIENT_CLIPPING_DEFAULT)
 
@@ -230,6 +247,12 @@ class DeepSpeedConfig:
             self.optimizer_name = None
             self.optimizer_params = {}
             self.optimizer_legacy_fusion = False
+        # optimizer.params.fused: the Pallas single-pass multi-tensor apply
+        # (ops/fused_update.py). Default on; build_optimizer only honors it
+        # for the Adam family, and the engine falls back to the optax chain
+        # where fusion does not compose (TP param layouts).
+        self.optimizer_fused = bool((self.optimizer_params or {}).get(
+            C.OPTIMIZER_FUSED, C.OPTIMIZER_FUSED_DEFAULT))
 
         scheduler = d.get(C.SCHEDULER)
         if scheduler is not None:

@@ -48,7 +48,8 @@ from .fp16.loss_scaler import (LossScaleState, make_loss_scale_state,
                                update_loss_scale)
 from .lr_schedules import get_lr_schedule
 from .progressive_layer_drop import ProgressiveLayerDrop
-from .utils import clip_grad_norm_, global_norm, tree_has_inf_or_nan
+from .utils import (clip_coefficient, clip_grad_norm_, global_norm,
+                    tree_has_inf_or_nan)
 from .zero.partition import zero_shardings
 from .. import constants as C
 from ..ops.optimizers import build_optimizer
@@ -248,6 +249,21 @@ class DeepSpeedEngine:
                     "combining with a TP layout would silently all-gather "
                     "every step")
         self.tx = self._configure_optimizer(optimizer)
+        if getattr(self.tx, "fused_apply", None) is not None and \
+                param_shardings is not None and optimizer is None:
+            # Fused apply flattens leaves into contiguous chunk buffers,
+            # which would silently all-gather TP-sharded params every
+            # step — fall back to the per-leaf optax chain there (parity
+            # holds everywhere the fused path stays on).
+            logger.info("optimizer.params.fused: disabled under tensor-"
+                        "parallel param_shardings (flattened chunks do not "
+                        "compose with TP layouts); using the optax apply")
+            fallback = dict(self.config.optimizer_params or {})
+            fallback[C.OPTIMIZER_FUSED] = False
+            self.tx = build_optimizer(
+                self.config.optimizer_name or C.ADAM_OPTIMIZER, fallback,
+                self._schedule_fn)
+        self._fused_apply = getattr(self.tx, "fused_apply", None)
 
         # ZeRO-Offload: masters + moments live in host RAM, updated by the
         # C++ SIMD Adam; the device holds ONLY compute-dtype params and
@@ -905,7 +921,7 @@ class DeepSpeedEngine:
         engine.py:1197-1253). Under fp16 the loss is scale-multiplied so
         grads come out SCALED (dense and sparse alike); the reported loss
         is the raw mean."""
-        shard_map = jax.shard_map
+        shard_map = comm.shard_map
         gas = self._scan_microbatches()
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
@@ -967,8 +983,11 @@ class DeepSpeedEngine:
         are unscaled here; the overflow vote spans BOTH (dense in-graph,
         sparse via the host-computed flag), and overflow skips the step
         and drives the dynamic scale machine exactly like the main path
-        (reference engine.py:1000-1085)."""
+        (reference engine.py:1000-1085). Returns the step's loss scale as
+        a traced output: the donated input state's buffer is deleted on
+        return, so the caller must not read it afterwards."""
         tx = self.tx
+        fused_apply = self._fused_apply
         clip = self.gradient_clipping()
         schedule_fn = self._schedule_fn
         fp16 = self.config.fp16_enabled
@@ -979,8 +998,9 @@ class DeepSpeedEngine:
         mask = self._sparse_mask
 
         def apply_step(state, grads, sparse_overflow):
+            scale = state.loss_scale
             if fp16:
-                inv = 1.0 / state.loss_scale
+                inv = 1.0 / scale
                 grads = jax.tree_util.tree_map(
                     lambda g, m: g if m else g * inv, grads, mask)
                 overflow = jnp.logical_or(sparse_overflow,
@@ -988,12 +1008,21 @@ class DeepSpeedEngine:
             else:
                 overflow = jnp.asarray(False)
             grad_norm = global_norm(grads)
-            if clip and clip > 0:
-                coeff = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * coeff, grads)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            import optax
-            new_params = optax.apply_updates(state.params, updates)
+            if fused_apply is not None:
+                # Same single-pass apply as the main step, clip folded in.
+                coeff = clip_coefficient(grad_norm, clip) \
+                    if (clip and clip > 0) else None
+                new_params, new_opt = fused_apply(
+                    grads, state.opt_state, state.params, clip_coeff=coeff)
+            else:
+                if clip and clip > 0:
+                    coeff = clip_coefficient(grad_norm, clip)
+                    grads = jax.tree_util.tree_map(lambda g: g * coeff,
+                                                   grads)
+                updates, new_opt = tx.update(grads, state.opt_state,
+                                             state.params)
+                import optax
+                new_params = optax.apply_updates(state.params, updates)
             keep = overflow
             new_params = _tree_select(keep, state.params, new_params)
             new_opt = _tree_select(keep, state.opt_state, new_opt)
@@ -1017,7 +1046,11 @@ class DeepSpeedEngine:
                 hysteresis=new_hyst,
                 skipped_steps=state.skipped_steps +
                 jnp.where(keep, 1, 0).astype(jnp.int32))
-            return new_state, grad_norm, schedule_fn(state.step), overflow
+            # ``scale`` is returned as a traced output: the input state is
+            # DONATED, so reading state.loss_scale after this call would
+            # touch a deleted buffer (the round-5 steps_per_print crash).
+            return new_state, grad_norm, schedule_fn(state.step), overflow, \
+                scale
 
         return jax.jit(apply_step, donate_argnums=(0,))
 
@@ -1085,10 +1118,10 @@ class DeepSpeedEngine:
             grads, inv_scale=inv)
         self.sparse_comm_stats = {"sparse_elements": int(shipped),
                                   "dense_elements": int(dense_n)}
-        self.state, grad_norm, lr, overflow = self._sparse_apply_fn(
-            self.state, grads, jnp.asarray(sp_overflow))
+        self.state, grad_norm, lr, overflow, scale_out = \
+            self._sparse_apply_fn(self.state, grads, jnp.asarray(sp_overflow))
         return {"loss": loss, "grad_norm": grad_norm, "lr": lr,
-                "loss_scale": scale, "overflow": overflow}
+                "loss_scale": scale_out, "overflow": overflow}
 
     # ------------------------------------------------------------------ #
     # The jitted train step
@@ -1097,7 +1130,7 @@ class DeepSpeedEngine:
         """1-bit Adam step: per-rank local grads inside shard_map over dp,
         error-feedback sign-compressed momentum allreduce (ops/onebit.py;
         reference onebit_adam.py:104-228)."""
-        shard_map = jax.shard_map
+        shard_map = comm.shard_map
         from ..ops.onebit import onebit_adam_update
         gas = self._scan_microbatches()
         flat_batch = self.dp_size == 1 and jax.process_count() == 1
@@ -1233,6 +1266,7 @@ class DeepSpeedEngine:
         loss_fn = self.loss_fn
         compute_dtype = self.compute_dtype
         tx = self.tx
+        fused_apply = self._fused_apply
         scale_window = self._scale_window
         min_scale = self._min_scale
         hysteresis_init = self._hysteresis
@@ -1307,15 +1341,15 @@ class DeepSpeedEngine:
             elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
                 # add pass over the fp32 grad tree every step. Master-free
-                # mode keeps the grads in their born bf16: the optimizer
-                # math promotes per-op to its f32 moments anyway, and the
-                # f32 grad round-trip is a full extra pass over HBM.
+                # included: grads are promoted to f32 here so the optax
+                # fallback's second moment is (f32 g)^2, never a bf16
+                # square (the fused kernel promotes on read by
+                # construction); XLA folds the widening cast into the
+                # consumer, so no extra materialized pass.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
                 (_, raw_loss), grads = grad_fn(loss_params, mb, keys[0],
                                                scale, theta)
-                grads = constrain_grads(
-                    grads if master_free
-                    else _cast_floats(grads, jnp.float32))
+                grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
@@ -1349,25 +1383,42 @@ class DeepSpeedEngine:
                 # Full-tree norm is an extra HBM pass; only pay for it when
                 # something consumes it (clipping / overflow diagnostics).
                 grad_norm = jnp.asarray(-1.0, jnp.float32)
-            if clip and clip > 0:
-                grads, _ = clip_grad_norm_(grads, clip, precomputed_norm=grad_norm)
-
-            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-            import optax
-            if master_free:
-                # Master-free bf16: the f32 update lands on the bf16 param
-                # via unbiased stochastic rounding — sub-ulp updates
-                # survive in expectation instead of being dropped by
-                # round-to-nearest (ops/stochastic_rounding.py).
-                from ..ops.stochastic_rounding import \
-                    tree_stochastic_round_bf16
-                summed = jax.tree_util.tree_map(
-                    lambda p, u: p.astype(jnp.float32) + u,
-                    state.params, updates)
-                new_params = tree_stochastic_round_bf16(
-                    summed, jax.random.fold_in(rng, 0x5352))
+            if fused_apply is not None:
+                # Single-pass Pallas multi-tensor apply: one HBM pass per
+                # chunk reads grad+param+m+v and writes param+m+v, the
+                # global-clip coefficient rides into the kernel's grad
+                # read (no separate clip pass over the tree), and in
+                # master-free mode the unbiased bf16 stochastic rounding
+                # happens on the in-kernel param write.
+                clip_coeff = clip_coefficient(grad_norm, clip) \
+                    if (clip and clip > 0) else None
+                new_params, new_opt_state = fused_apply(
+                    grads, state.opt_state, state.params,
+                    clip_coeff=clip_coeff,
+                    sr_key=(jax.random.fold_in(rng, 0x5352)
+                            if master_free else None))
             else:
-                new_params = optax.apply_updates(state.params, updates)
+                if clip and clip > 0:
+                    grads, _ = clip_grad_norm_(grads, clip,
+                                               precomputed_norm=grad_norm)
+                updates, new_opt_state = tx.update(grads, state.opt_state,
+                                                   state.params)
+                import optax
+                if master_free:
+                    # Master-free bf16: the f32 update lands on the bf16
+                    # param via unbiased stochastic rounding — sub-ulp
+                    # updates survive in expectation instead of being
+                    # dropped by round-to-nearest
+                    # (ops/stochastic_rounding.py).
+                    from ..ops.stochastic_rounding import \
+                        tree_stochastic_round_bf16
+                    summed = jax.tree_util.tree_map(
+                        lambda p, u: p.astype(jnp.float32) + u,
+                        state.params, updates)
+                    new_params = tree_stochastic_round_bf16(
+                        summed, jax.random.fold_in(rng, 0x5352))
+                else:
+                    new_params = optax.apply_updates(state.params, updates)
             # Refresh the compute-dtype cache in the same fused pass as the
             # param update (one extra compute-dtype write instead of next
             # step's full fp32 re-read + cast).
@@ -1677,16 +1728,26 @@ class DeepSpeedEngine:
             grad_sh, NamedSharding(self.mesh, P()))) \
             if grad_sh is not None else jax.jit(grad_step)
 
+        fused_apply = self._fused_apply
+
         def apply_grads(state: EngineState, grads):
             scale = state.loss_scale
             grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
             overflow = tree_has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
             grad_norm = global_norm(grads)
-            if clip and clip > 0:
-                grads, _ = clip_grad_norm_(grads, clip, precomputed_norm=grad_norm)
-            updates, new_opt = tx.update(grads, state.opt_state, state.params)
-            import optax
-            new_params = optax.apply_updates(state.params, updates)
+            if fused_apply is not None:
+                coeff = clip_coefficient(grad_norm, clip) \
+                    if (clip and clip > 0) else None
+                new_params, new_opt = fused_apply(
+                    grads, state.opt_state, state.params, clip_coeff=coeff)
+            else:
+                if clip and clip > 0:
+                    grads, _ = clip_grad_norm_(grads, clip,
+                                               precomputed_norm=grad_norm)
+                updates, new_opt = tx.update(grads, state.opt_state,
+                                             state.params)
+                import optax
+                new_params = optax.apply_updates(state.params, updates)
             # Same cache refresh as the fused train step: the next
             # train_batch reads state.cast_params.
             new_cast = None

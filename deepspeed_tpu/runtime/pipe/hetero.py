@@ -89,30 +89,53 @@ def hetero_pipe_spec(embed_fn: Callable, head_fn: Callable,
         blocks[f"prog{p}"] = jax.tree_util.tree_map(
             lambda *xs: jnp.stack(xs), *slices)
 
+    def _check_boundary(p: int, got_shape, got_dtype, want_shape,
+                        want_dtype) -> None:
+        if got_shape != tuple(want_shape):
+            raise ValueError(
+                f"program {p} changes the boundary shape {tuple(want_shape)}"
+                f" -> {got_shape}; pipeline stages must preserve it (the "
+                "ppermute buffer is one uniform array)")
+        if got_dtype != want_dtype:
+            raise ValueError(
+                f"program {p} changes the boundary dtype {want_dtype} -> "
+                f"{got_dtype}; pipeline stages must preserve it (the "
+                "ppermute buffer is one uniform array)")
+
     if sample_x is not None:
         key = rng if rng is not None else jax.random.PRNGKey(0)
-        want = jnp.asarray(sample_x).shape
+        # Canonicalize (no-op for jax arrays): a numpy float64 sample must
+        # probe as the dtype jax would actually trace it to, or every
+        # program would spuriously fail the dtype check under x64-disabled.
+        sample = jnp.asarray(sample_x)
+        probe = jax.ShapeDtypeStruct(sample.shape, sample.dtype)
         for p in range(K):
-            got = jax.eval_shape(programs[p], templates[p],
-                                 jax.ShapeDtypeStruct(want, jnp.float32),
-                                 key).shape
-            if got != want:
-                raise ValueError(
-                    f"program {p} changes the boundary shape {want} -> "
-                    f"{got}; pipeline stages must preserve it (the "
-                    "ppermute buffer is one uniform array)")
+            got = jax.eval_shape(programs[p], templates[p], probe, key)
+            _check_boundary(p, got.shape, got.dtype, probe.shape,
+                            probe.dtype)
 
     table = jnp.asarray(list(stage_programs), jnp.int32)
 
     def stage_fn(blocks_local, x, rng):
-        r = lax.axis_index(PP_AXIS)
         # blocks_local leaves carry the [P]-sharded leading dim (length 1
         # per rank under pp=P meshes): drop it to this stage's slice.
         outs = [programs[p](
             jax.tree_util.tree_map(lambda a: a[0],
                                    blocks_local[f"prog{p}"]), x, rng)
             for p in range(K)]
-        return outs[0] if K == 1 else lax.select_n(table[r], *outs)
+        # Build-time boundary check, sample_x or not: shapes/dtypes are
+        # static under trace, so a shape- or dtype-changing program fails
+        # HERE with a real message when the pipeline program is built —
+        # not as an opaque select_n/ppermute mismatch deep in the trace.
+        # (Deliberately before axis_index: the error must surface even in
+        # a bare eval_shape outside the mesh.)
+        for p, out in enumerate(outs):
+            _check_boundary(p, jnp.shape(out), jnp.result_type(out),
+                            jnp.shape(x), jnp.result_type(x))
+        if K == 1:
+            return outs[0]
+        r = lax.axis_index(PP_AXIS)
+        return lax.select_n(table[r], *outs)
 
     shardings = pipeline_param_shardings(
         shared_specs=shared_specs or

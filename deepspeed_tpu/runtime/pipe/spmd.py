@@ -57,6 +57,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ...parallel import comm
 from ...parallel.topology import PP_AXIS
 
 
@@ -83,8 +84,8 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
         r = lax.axis_index(PP_AXIS)
         stage = jax.checkpoint(stage_fn) if remat else stage_fn
 
-        buf0 = lax.pcast(jnp.zeros(micro_x.shape[1:], cdtype), PP_AXIS, to='varying')
-        out0 = lax.pcast(jnp.zeros(micro_x.shape, cdtype), PP_AXIS, to='varying')
+        buf0 = comm.pvary(jnp.zeros(micro_x.shape[1:], cdtype), PP_AXIS)
+        out0 = comm.pvary(jnp.zeros(micro_x.shape, cdtype), PP_AXIS)
 
         def tick(carry, t):
             buf, out = carry
@@ -96,8 +97,8 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
             # CHECK-fails on sdy-annotated reduction computations in this
             # XLA build). Only the [mb, ...] tick slice is ever fp32 — the
             # O(M) bank itself stays bf16.
-            x0 = lax.pcast(x0.astype(jnp.float32), PP_AXIS,
-                           to='varying').astype(cdtype)
+            x0 = comm.pvary(x0.astype(jnp.float32),
+                           PP_AXIS).astype(cdtype)
             x_in = jnp.where(r == 0, x0, buf)
             key_t = jax.random.fold_in(rng, t)
             y = stage(blocks_local, x_in, key_t)
@@ -135,7 +136,7 @@ def spmd_pipeline_loss(embed_fn: Callable, stage_fn: Callable,
         x = jax.vmap(lambda tk, i: embed_fn(
             shared, tk, jax.random.fold_in(rng, T + i)))(micro_tokens, midx)
 
-        mapped = jax.shard_map(
+        mapped = comm.shard_map(
             partial(per_stage, cdtype=x.dtype), mesh=mesh,
             in_specs=(P(PP_AXIS), P(), P()),
             out_specs=P(PP_AXIS),

@@ -71,6 +71,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ...parallel import comm
 from ...parallel.topology import PP_AXIS
 from .spmd import _split_batch, _to_micro
 
@@ -282,7 +283,7 @@ def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
             shared, jax.tree_util.tree_map(lambda a: a[0], micro_tokens))
         cdtype = x_shape.dtype
 
-        mapped = jax.shard_map(
+        mapped = comm.shard_map(
             partial(per_stage, cdtype=cdtype, xshape=x_shape.shape),
             mesh=mesh,
             in_specs=(P(PP_AXIS), P(), P(), P(), P(), P()),

@@ -79,12 +79,19 @@ def get_weight_norm(params: Any, mpu=None, norm_type: int = 2) -> jax.Array:
     return global_norm(params, ord=norm_type)
 
 
+def clip_coefficient(total_norm: jax.Array, max_norm: float) -> jax.Array:
+    """The global-clip multiplier. Single definition shared by the optax
+    fallback (clip_grad_norm_) and the fused apply's in-kernel folding
+    (runtime/engine.py), so the two paths cannot silently diverge."""
+    return jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+
+
 def clip_grad_norm_(grads: Any, max_norm: float, norm_type: int = 2,
                     precomputed_norm: Optional[jax.Array] = None) -> Tuple[Any, jax.Array]:
     """Return (clipped_grads, total_norm); jit-safe, non-mutating."""
     total_norm = precomputed_norm if precomputed_norm is not None \
         else global_norm(grads, ord=norm_type)
-    clip_coef = jnp.minimum(1.0, max_norm / (total_norm + 1e-6))
+    clip_coef = clip_coefficient(total_norm, max_norm)
     clipped = jax.tree_util.tree_map(
         lambda g: (g.astype(jnp.float32) * clip_coef).astype(g.dtype), grads)
     return clipped, total_norm

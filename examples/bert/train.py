@@ -60,10 +60,15 @@ def main():
         config=ds_config)
 
     tokens, labels = synthetic_mlm(8 * 16, cfg)
+    losses = []
     for step in range(args.steps):
         lo = (step * 8) % (len(tokens) - 8)
         loss = engine.train_batch((tokens[lo:lo + 8], labels[lo:lo + 8]))
-    print(f"final MLM loss: {float(jax.device_get(loss)):.4f}")
+        losses.append(float(jax.device_get(loss)))
+    # stdout contract consumed by tests/test_examples.py: the full curve
+    # (decreasing-loss check) and the final value.
+    print("losses:", " ".join(f"{l:.6f}" for l in losses))
+    print(f"final MLM loss: {losses[-1]:.4f}")
 
 
 if __name__ == "__main__":

@@ -78,10 +78,15 @@ def main():
         model=loss_fn, model_params=init_params(jax.random.PRNGKey(0)),
         config=config)
     x, y = synthetic_cifar(64 * 8)
+    losses = []
     for step in range(args.steps):
         lo = (step * 64) % (64 * 8)
         loss = engine.train_batch((x[lo:lo + 64], y[lo:lo + 64]))
-    print(f"final loss: {float(jax.device_get(loss)):.4f}")
+        losses.append(float(jax.device_get(loss)))
+    # stdout contract consumed by tests/test_examples.py: the full curve
+    # (decreasing-loss check) and the final value.
+    print("losses:", " ".join(f"{l:.6f}" for l in losses))
+    print(f"final loss: {losses[-1]:.4f}")
 
 
 if __name__ == "__main__":

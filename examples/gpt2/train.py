@@ -63,17 +63,35 @@ def main():
     if args.data:
         tokens = np.load(args.data).astype(np.int32)
     else:
+        # Markov synthetic stream: the next token is a fixed affine map of
+        # the current one 90% of the time. A uniform random stream would
+        # already sit AT the ln(V) optimum from init — unlearnable by
+        # construction — while this has real next-token structure, so the
+        # loss visibly decreases within a few dozen steps (the contract
+        # tests/test_examples.py checks).
         rng = np.random.default_rng(0)
-        tokens = rng.integers(0, cfg.vocab_size,
-                              size=(bs * 16, S + 1)).astype(np.int32)
+        n = bs * 16
+        cols = [rng.integers(0, cfg.vocab_size, size=(n, 1))]
+        resample = rng.random((n, S)) < 0.1
+        rand = rng.integers(0, cfg.vocab_size, size=(n, S))
+        for t in range(S):
+            nxt = (cols[-1] * 7 + 1) % cfg.vocab_size
+            cols.append(np.where(resample[:, t:t + 1],
+                                 rand[:, t:t + 1], nxt))
+        tokens = np.concatenate(cols, axis=1).astype(np.int32)
 
     assert len(tokens) >= bs, \
         f"need >= {bs} rows (train_batch_size), got {len(tokens)}"
     n_windows = max(1, len(tokens) - bs + 1)
+    losses = []
     for step in range(args.steps):
         lo = (step * bs) % n_windows
         loss = engine.train_batch(tokens[lo:lo + bs])
-    print(f"final loss: {float(jax.device_get(loss)):.4f}")
+        losses.append(float(jax.device_get(loss)))
+    # stdout contract consumed by tests/test_examples.py: the full curve
+    # (decreasing-loss check) and the final value.
+    print("losses:", " ".join(f"{l:.6f}" for l in losses))
+    print(f"final loss: {losses[-1]:.4f}")
     if args.checkpoint_dir:
         engine.save_checkpoint(args.checkpoint_dir)
 

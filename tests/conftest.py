@@ -20,6 +20,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+if not hasattr(jax, "set_mesh"):
+    # jax<0.6 compat: tests use the newer ``with jax.set_mesh(mesh):``
+    # context; a Mesh is itself the legacy context manager with the same
+    # effect, so the shim just returns it.
+    jax.set_mesh = lambda mesh: mesh
+
 import pytest  # noqa: E402
 
 

@@ -88,6 +88,54 @@ class TestPrecision:
         assert cfg.fp16_hysteresis == 2
         assert cfg.fp16_min_loss_scale == 1
 
+    def test_amp_maps_to_bf16(self):
+        """amp must act, never silently no-op (reference engine.py:630-668
+        wraps apex; the TPU equivalent of amp O1 is the bf16 path)."""
+        cfg = make_cfg({"train_batch_size": 8, "amp": {"enabled": True}})
+        assert cfg.amp_enabled and cfg.bf16_enabled
+        assert cfg.precision_dtype == "bfloat16"
+
+    def test_amp_with_bf16_is_idempotent(self):
+        cfg = make_cfg({"train_batch_size": 8, "amp": {"enabled": True},
+                        "bf16": {"enabled": True}})
+        assert cfg.precision_dtype == "bfloat16"
+
+    def test_amp_with_fp16_raises(self):
+        with pytest.raises(DeepSpeedConfigError, match="bf16|fp16"):
+            make_cfg({"train_batch_size": 8, "amp": {"enabled": True},
+                      "fp16": {"enabled": True}})
+
+    def test_amp_disabled_is_inert(self):
+        cfg = make_cfg({"train_batch_size": 8, "amp": {"enabled": False}})
+        assert not cfg.amp_enabled and not cfg.bf16_enabled
+
+
+class TestFusedOptimizer:
+    def test_fused_default_on(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "optimizer": {"type": "AdamW",
+                                      "params": {"lr": 1e-3}}})
+        assert cfg.optimizer_fused
+
+    def test_fused_off(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "optimizer": {"type": "AdamW",
+                                      "params": {"lr": 1e-3,
+                                                 "fused": False}}})
+        assert not cfg.optimizer_fused
+
+    def test_build_optimizer_honors_knob(self):
+        from deepspeed_tpu.ops.optimizers import build_optimizer
+        fused = build_optimizer("adamw", {"lr": 1e-3})
+        assert getattr(fused, "fused_apply", None) is not None
+        plain = build_optimizer("adamw", {"lr": 1e-3, "fused": False})
+        assert getattr(plain, "fused_apply", None) is None
+        # fused never hijacks non-Adam or onebit paths
+        lamb = build_optimizer("lamb", {"lr": 1e-3})
+        assert getattr(lamb, "fused_apply", None) is None
+        onebit = build_optimizer("onebitadam", {"lr": 1e-3})
+        assert getattr(onebit, "fused_apply", None) is None
+
 
 class TestZeroConfig:
     def test_defaults(self):

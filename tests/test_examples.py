@@ -4,14 +4,18 @@ tier drives its example trainers as whole programs
 here each example runs as a real subprocess on the virtual CPU mesh and
 must train to a finite, decreasing loss.
 
-Kept honest by parsing the script's own stdout contract ("final loss:"),
-not by importing its internals.
+Kept honest by parsing the scripts' own stdout contract ("losses: ..." +
+"final loss:"), not by importing their internals. Every example must not
+just run — the first-quarter vs last-quarter window means of its printed
+loss curve must DECREASE (the module's "finite, decreasing loss" claim;
+the reference's func tests compare full loss curves).
 """
 import os
 import re
 import subprocess
 import sys
 
+import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
@@ -32,33 +36,46 @@ def run_example(rel, *args, timeout=420):
     assert p.returncode == 0, f"{rel} failed:\n{p.stdout}\n{p.stderr}"
     m = re.search(r"final (?:MLM )?loss:\s*([0-9.]+)", p.stdout)
     assert m, f"{rel} printed no final loss:\n{p.stdout[-2000:]}"
-    return float(m.group(1))
+    c = re.search(r"losses:\s*([0-9. eE+-]+)", p.stdout)
+    assert c, f"{rel} printed no loss curve:\n{p.stdout[-2000:]}"
+    return float(m.group(1)), [float(x) for x in c.group(1).split()]
+
+
+def assert_decreasing(losses, factor=0.97):
+    """First-k vs last-k window means must drop by at least (1-factor):
+    per-step curves are noisy, window means are the honest signal."""
+    k = max(1, len(losses) // 4)
+    first, last = np.mean(losses[:k]), np.mean(losses[-k:])
+    assert last < factor * first, (first, last, losses)
 
 
 def test_cifar_example_runs_and_learns():
-    loss = run_example("examples/cifar/train.py", "--steps", "60")
+    loss, curve = run_example("examples/cifar/train.py", "--steps", "60")
     assert loss < 2.3, loss            # below the ln(10) random floor
+    assert_decreasing(curve)
 
 
-def test_bert_example_runs():
-    loss = run_example("examples/bert/train.py", "--steps", "12")
-    assert loss > 0.0                  # finite, parsed from the script
+def test_bert_example_learns():
+    _, curve = run_example("examples/bert/train.py", "--steps", "48")
+    assert_decreasing(curve)
 
 
 def test_gpt2_example_zero2():
-    loss = run_example("examples/gpt2/train.py",
-                       "--config", "ds_config_zero2.json", "--steps", "12")
-    assert loss > 0.0
+    _, curve = run_example("examples/gpt2/train.py",
+                           "--config", "ds_config_zero2.json",
+                           "--steps", "24")
+    assert_decreasing(curve)
 
 
 def test_gpt2_example_onebit():
-    loss = run_example("examples/gpt2/train.py",
-                       "--config", "ds_config_onebit.json", "--steps", "12")
-    assert loss > 0.0
+    _, curve = run_example("examples/gpt2/train.py",
+                           "--config", "ds_config_onebit.json",
+                           "--steps", "48")
+    assert_decreasing(curve)
 
 
 def test_gpt2_example_pipeline_1f1b():
-    loss = run_example("examples/gpt2/train.py",
-                       "--config", "ds_config_pipeline.json",
-                       "--pipeline", "--steps", "8")
-    assert loss > 0.0
+    _, curve = run_example("examples/gpt2/train.py",
+                           "--config", "ds_config_pipeline.json",
+                           "--pipeline", "--steps", "24")
+    assert_decreasing(curve)

@@ -1,0 +1,314 @@
+"""Fused Pallas multi-tensor optimizer apply (ops/fused_update.py) vs the
+optax reference apply — the parity contract for the reference's
+``csrc/adam/multi_tensor_adam.cu`` equivalent.
+
+Parity tiers:
+- moments: BIT-equal with optax (same association order, f32 throughout);
+- params (deterministic path): equal to within ~2 f32 ulp — strict bitwise
+  equality across two separately-compiled XLA programs is not achievable
+  because XLA contracts ``p + u*lr`` into an FMA inside one fusion and not
+  the other (verified: one jit of ``p + u*lr`` vs staged mul/add differs in
+  the last ulp on CPU); the FMA result is the *more* accurate one;
+- params (stochastic-rounding path, seeded): both engines land within one
+  bf16 ulp of the same f32 trajectory, so trajectories agree to bf16
+  tolerance.
+
+Engine tier runs on the 8-device CPU mesh under ZeRO-2, covering the
+fp32-master, master-free bf16+SR, and gas>1 scan paths, plus the
+``optimizer.params.fused`` config knob in both positions.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.ops.fused_update import fused_adam, FusedAdamState
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import build_mesh
+
+B1, B2, EPS, WD = 0.9, 0.999, 1e-8, 0.01
+
+
+def _tree(seed=0, dtype=np.float32):
+    r = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(r.standard_normal((37, 5)).astype(dtype)),
+        "big": jnp.asarray(r.standard_normal(140001).astype(dtype)),
+        "b": jnp.asarray(r.standard_normal(()).astype(dtype)),
+    }
+
+
+def _grads(i, like):
+    r = np.random.default_rng(1000 + i)
+    return jax.tree_util.tree_map(
+        lambda x: jnp.asarray(
+            r.standard_normal(x.shape).astype(np.float32)).astype(x.dtype),
+        like)
+
+
+def _sched(c):
+    return jnp.asarray(1e-3, jnp.float32)
+
+
+def _flat_moments(tree):
+    """optax moment tree -> flat f32 vector in the fused buffer's leaf
+    order (tree_flatten order; all-f32 params = one group)."""
+    return np.concatenate([np.asarray(l, np.float32).reshape(-1)
+                           for l in jax.tree_util.tree_leaves(tree)])
+
+
+class TestTransformParity:
+    def test_adamw_moments_bitexact_params_ulp(self):
+        params = _tree()
+        ref = optax.adamw(_sched, b1=B1, b2=B2, eps=EPS, weight_decay=WD)
+        fus = fused_adam(_sched, B1, B2, EPS, WD, adam_w_mode=True)
+        rs, fs = ref.init(params), fus.init(params)
+        p_ref = p_fus = params
+        upd_ref = jax.jit(ref.update)
+        upd_fus = jax.jit(fus.fused_apply)
+        for i in range(4):
+            g = _grads(i, params)
+            u, rs = upd_ref(g, rs, p_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+            p_fus, fs = upd_fus(g, fs, p_fus)
+            n = _flat_moments(rs[0].mu).size
+            np.testing.assert_array_equal(
+                _flat_moments(rs[0].mu), np.asarray(fs.m[0][:n]),
+                err_msg=f"first moment diverged at step {i}")
+            np.testing.assert_array_equal(
+                _flat_moments(rs[0].nu), np.asarray(fs.v[0][:n]),
+                err_msg=f"second moment diverged at step {i}")
+            for k in params:
+                np.testing.assert_allclose(
+                    np.asarray(p_ref[k]), np.asarray(p_fus[k]),
+                    rtol=1e-6, atol=1e-7, err_msg=f"step {i} leaf {k}")
+        # the pad region of the fused buffers stays exactly zero
+        assert not np.any(np.asarray(fs.m[0][n:]))
+
+    def test_coupled_adam_parity(self):
+        """adam_w_mode=False folds decay into the grad BEFORE the moments
+        (the engine's classic-Adam chain)."""
+        params = _tree(3)
+        ref = optax.chain(optax.add_decayed_weights(WD),
+                          optax.scale_by_adam(b1=B1, b2=B2, eps=EPS),
+                          optax.scale_by_learning_rate(_sched))
+        fus = fused_adam(_sched, B1, B2, EPS, WD, adam_w_mode=False)
+        rs, fs = ref.init(params), fus.init(params)
+        p_ref = p_fus = params
+        for i in range(3):
+            g = _grads(i, params)
+            u, rs = jax.jit(ref.update)(g, rs, p_ref)
+            p_ref = optax.apply_updates(p_ref, u)
+            p_fus, fs = jax.jit(fus.fused_apply)(g, fs, p_fus)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_ref[k]),
+                                       np.asarray(p_fus[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_clip_coeff_folded_in_kernel(self):
+        """fused_apply(clip_coeff=c) == fused_apply on pre-scaled grads."""
+        params = _tree(4)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        c = jnp.asarray(0.37, jnp.float32)
+        p_a, _ = jax.jit(fus.fused_apply)(
+            jax.tree_util.tree_map(lambda x: x * c, g), fs, params)
+        p_b, _ = jax.jit(lambda g, s, p: fus.fused_apply(
+            g, s, p, clip_coeff=c))(g, fs, params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_a[k]),
+                                       np.asarray(p_b[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_optax_update_contract(self):
+        """The generic optax-style update (delta + apply_updates) lands on
+        the fused_apply params (generic callers keep working)."""
+        params = _tree(5)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        u, _ = jax.jit(fus.update)(g, fs, params)
+        via_update = optax.apply_updates(params, u)
+        direct, _ = jax.jit(fus.fused_apply)(g, fs, params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(via_update[k]),
+                                       np.asarray(direct[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_per_leaf_mode_matches_chunked(self):
+        params = _tree(6)
+        chunked = fused_adam(_sched, B1, B2, EPS, WD)
+        per_leaf = fused_adam(_sched, B1, B2, EPS, WD, multi_tensor=False)
+        cs, ps = chunked.init(params), per_leaf.init(params)
+        g = _grads(0, params)
+        p_c, _ = jax.jit(chunked.fused_apply)(g, cs, params)
+        p_l, _ = jax.jit(per_leaf.fused_apply)(g, ps, params)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p_c[k]),
+                                       np.asarray(p_l[k]),
+                                       rtol=1e-6, atol=1e-7)
+
+    def test_bf16_params_keep_f32_grads(self):
+        """Master-free regression: the front end must flatten grads in f32
+        — the engine accumulates them in f32 over bf16 params, and a cast
+        to the param-group dtype would truncate them before the kernel's
+        f32 moment update ever sees them."""
+        g_val = 1.0 + 1 / 4096            # NOT bf16-representable
+        params = {"w": jnp.full((64,), 0.5, jnp.bfloat16)}
+        g = {"w": jnp.full((64,), g_val, jnp.float32)}
+        fus = fused_adam(_sched, B1, B2, EPS, 0.0)
+        _, fs = jax.jit(fus.fused_apply)(g, fus.init(params), params)
+        np.testing.assert_allclose(np.asarray(fs.m[0][:64]),
+                                   np.float32((1 - B1) * g_val), rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(fs.v[0][:64]),
+                                   np.float32((1 - B2) * g_val ** 2),
+                                   rtol=1e-5)
+
+    def test_stochastic_rounding_in_kernel(self):
+        """bf16 params + sr_key: the write lands on a bf16 neighbor of the
+        f32 result (within one bf16 ulp), moments stay f32, and distinct
+        seeds produce distinct roundings."""
+        params = _tree(7, dtype=jnp.bfloat16)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        gb = jax.tree_util.tree_map(lambda x: x.astype(jnp.bfloat16), g)
+        apply = jax.jit(lambda g, s, p, k: fus.fused_apply(g, s, p,
+                                                           sr_key=k))
+        p_sr, fs_sr = apply(gb, fs, params, jax.random.PRNGKey(0))
+        p_sr2, _ = apply(gb, fs, params, jax.random.PRNGKey(1))
+        # deterministic f32 reference of the same update
+        p32 = jax.tree_util.tree_map(
+            lambda x: x.astype(jnp.float32), params)
+        f32 = fused_adam(_sched, B1, B2, EPS, WD)
+        p_ref, _ = jax.jit(f32.fused_apply)(gb, f32.init(p32), p32)
+        any_diff = False
+        for k in params:
+            assert p_sr[k].dtype == jnp.bfloat16
+            a = np.asarray(p_sr[k], np.float32)
+            r = np.asarray(p_ref[k], np.float32)
+            # one bf16 ulp at the reference's magnitude
+            ulp = np.maximum(np.abs(r), 1e-30) * 2 ** -7
+            assert np.all(np.abs(a - r) <= ulp + 1e-7), k
+            any_diff |= not np.array_equal(
+                np.asarray(p_sr[k], np.float32),
+                np.asarray(p_sr2[k], np.float32))
+        assert any_diff, "distinct seeds must round differently somewhere"
+        assert fs_sr.m[0].dtype == jnp.float32
+
+
+# ------------------------------------------------------------------ #
+# Engine tier — 8-device CPU mesh, ZeRO-2
+# ------------------------------------------------------------------ #
+DIM = 32
+_W_TRUE = np.random.default_rng(0).standard_normal(DIM).astype(np.float32)
+
+
+def loss_fn(params, batch, rng):
+    pred = batch["x"] @ params["w"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+def make_batch(i, n=64):
+    r = np.random.default_rng(i)
+    x = r.standard_normal((n, DIM)).astype(np.float32)
+    return {"x": jnp.asarray(x), "y": jnp.asarray(x @ _W_TRUE)}
+
+
+def _params():
+    return {"w": jnp.zeros((DIM,), jnp.float32),
+            "b": jnp.zeros((), jnp.float32)}
+
+
+def _cfg(fused, gas=1, **over):
+    cfg = {
+        "train_batch_size": 64,
+        "train_micro_batch_size_per_gpu": 64 // (8 * gas),
+        "gradient_accumulation_steps": gas,
+        "gradient_clipping": 1.0,
+        "optimizer": {"type": "AdamW",
+                      "params": {"lr": 1e-2, "fused": fused}},
+        "zero_optimization": {"stage": 2},
+        "bf16": {"enabled": True},
+        "steps_per_print": 10 ** 9,
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _run(cfg, steps=6):
+    eng = DeepSpeedEngine(model=loss_fn, model_params=_params(),
+                          config=cfg, mesh=build_mesh())
+    losses = [float(jax.device_get(eng.train_batch(make_batch(i))))
+              for i in range(steps)]
+    return eng, losses
+
+
+def test_config_knob_selects_path():
+    eng_f, _ = _run(_cfg(True), steps=1)
+    eng_o, _ = _run(_cfg(False), steps=1)
+    assert eng_f._fused_apply is not None
+    assert isinstance(eng_f.state.opt_state, FusedAdamState)
+    assert eng_o._fused_apply is None
+    assert not isinstance(eng_o.state.opt_state, FusedAdamState)
+    # default is ON for the Adam family
+    cfg = _cfg(True)
+    del cfg["optimizer"]["params"]["fused"]
+    eng_d, _ = _run(cfg, steps=1)
+    assert eng_d._fused_apply is not None
+    assert eng_d.config.optimizer_fused
+
+
+def test_engine_parity_fp32_master():
+    """bf16 compute + fp32 masters + clipping + ZeRO-2 over dp=8: fused and
+    optax trajectories agree to f32-ulp accumulation tolerance."""
+    eng_f, l_f = _run(_cfg(True))
+    eng_o, l_o = _run(_cfg(False))
+    np.testing.assert_allclose(l_f, l_o, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(eng_f.state.params["w"]),
+        np.asarray(eng_o.state.params["w"]), rtol=1e-5, atol=1e-6)
+
+
+def test_engine_parity_gas_scan_path():
+    eng_f, l_f = _run(_cfg(True, gas=2))
+    eng_o, l_o = _run(_cfg(False, gas=2))
+    np.testing.assert_allclose(l_f, l_o, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(eng_f.state.params["w"]),
+        np.asarray(eng_o.state.params["w"]), rtol=1e-5, atol=1e-6)
+
+
+def test_engine_parity_master_free_sr():
+    """Master-free bf16 + stochastic rounding (seeded): both paths round
+    the same f32 trajectory, so params agree to bf16 tolerance and the
+    state really is bf16 (no fp32 master anywhere)."""
+    bf16 = {"enabled": True, "stochastic_rounding": True}
+    eng_f, l_f = _run(_cfg(True, bf16=bf16))
+    eng_o, l_o = _run(_cfg(False, bf16=bf16))
+    assert eng_f.state.params["w"].dtype == jnp.bfloat16
+    assert eng_o.state.params["w"].dtype == jnp.bfloat16
+    np.testing.assert_allclose(l_f, l_o, rtol=0.2, atol=0.05)
+    np.testing.assert_allclose(
+        np.asarray(eng_f.state.params["w"], np.float32),
+        np.asarray(eng_o.state.params["w"], np.float32),
+        rtol=0.05, atol=0.05)
+    # and the run learns (the SR mode's whole point)
+    assert l_f[-1] < 0.5 * l_f[0]
+
+
+def test_engine_fused_checkpoint_roundtrip(tmp_path):
+    """Fused opt state (flat chunk buffers) survives the sharded
+    checkpoint save/load with the trajectory intact."""
+    eng, _ = _run(_cfg(True), steps=3)
+    eng.save_checkpoint(str(tmp_path), tag="t3")
+    eng2, _ = _run(_cfg(True), steps=1)
+    eng2.load_checkpoint(str(tmp_path), tag="t3")
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(eng.state.opt_state.m[0])),
+        np.asarray(jax.device_get(eng2.state.opt_state.m[0])))
+    l1 = float(jax.device_get(eng.train_batch(make_batch(100))))
+    l2 = float(jax.device_get(eng2.train_batch(make_batch(100))))
+    assert abs(l1 - l2) < 1e-6, (l1, l2)

@@ -89,6 +89,43 @@ def test_build_validates_shapes_and_programs():
                          sample_x=jnp.zeros((2, S, H)))
 
 
+def downcast(p, x, rng):                  # breaks the boundary dtype
+    return jnp.tanh(x @ p["a"]).astype(jnp.bfloat16) @ \
+        p["b"].astype(jnp.bfloat16)
+
+
+def test_build_validates_dtype_with_sample():
+    conv, mlp, shared = make_params()
+    with pytest.raises(ValueError, match="boundary dtype"):
+        hetero_pipe_spec(embed_fn, head_fn, [conv_prog, downcast],
+                         [0, 1], [conv, mlp], shared_params=shared,
+                         sample_x=jnp.zeros((2, S, H)))
+
+
+def test_build_validates_without_sample_x():
+    """No ``sample_x``: the check still fires the first time the stage
+    program is traced (pipeline build), shape- and dtype-changing modes
+    alike — a real message, not an opaque select_n mismatch."""
+    conv, mlp, shared = make_params()
+    bad_mlp = dict(mlp, a=jnp.zeros((H, 2 * H)))
+
+    def widen(p, x, rng):
+        return jnp.tanh(x @ p["a"])
+
+    x = jnp.zeros((2, S, H))
+    rng = jax.random.PRNGKey(0)
+
+    spec = hetero_pipe_spec(embed_fn, head_fn, [conv_prog, widen],
+                            [0, 1], [conv, bad_mlp], shared_params=shared)
+    with pytest.raises(ValueError, match="boundary shape"):
+        jax.eval_shape(spec.stage_fn, spec.params["blocks"], x, rng)
+
+    spec = hetero_pipe_spec(embed_fn, head_fn, [conv_prog, downcast],
+                            [0, 1], [conv, mlp], shared_params=shared)
+    with pytest.raises(ValueError, match="boundary dtype"):
+        jax.eval_shape(spec.stage_fn, spec.params["blocks"], x, rng)
+
+
 class TestParity:
     def test_gpipe_loss_and_grads_match_sequential(self, batch):
         spec = build_spec()

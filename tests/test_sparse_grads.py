@@ -183,3 +183,18 @@ def test_sparse_trains_to_convergence():
     losses = [float(jax.device_get(eng.train_batch(make_batch(i))))
               for i in range(30)]
     assert losses[-1] < 0.5 * losses[0]
+
+
+def test_sparse_logging_every_step():
+    """Regression (round-5 advisor, high): the sparse apply DONATES the
+    engine state, and metrics['loss_scale'] used to return the donated
+    (deleted) loss-scale buffer — any sparse run with steps_per_print=1
+    crashed inside _maybe_log's device_get. The scale must come back as a
+    traced output of the jitted apply, like the main train step."""
+    eng = DeepSpeedEngine(model=loss_fn, model_params=model_params(),
+                          config=_cfg(True, steps_per_print=1),
+                          mesh=build_mesh())
+    for i in range(2):
+        loss = eng.train_batch(make_batch(i))
+    assert np.isfinite(float(jax.device_get(loss)))
+    assert eng.loss_scale() == 1.0
