@@ -1,0 +1,197 @@
+"""Ablation: serial vs overlapped bucketed ZeRO-Offload, thread sweep.
+
+Runs a CPU-sized GPT-2 through the offload engine in every mode the new
+pipeline exposes and prints one JSON line per configuration:
+
+    python ablate_offload_overlap.py              # sweep, print lines
+    python ablate_offload_overlap.py --record     # + merge the measured
+                                                  #   overlap into
+                                                  #   OFFLOAD_BENCH.json
+
+What it measures (all on THIS host, same model, same seed):
+  - serial wall/step (overlap_comm: false — the parity baseline),
+  - overlapped wall/step at host_threads in {1, 2, cpu_count}, with the
+    engine's per-step overlap_fraction (1 - pipeline_span/pipeline_work:
+    the fraction of host-pipeline work hidden by concurrency),
+  - the speedup serial/overlap the record derives its projection from.
+
+Honest-methodology note (what --record writes): the 1.5B component
+measurements in OFFLOAD_BENCH.json (device-only step, host Adam, transfer
+bytes) come from the one-shot tunneled-chip run and are NOT touched. The
+ablation contributes the measured overlap_fraction and host-pipeline
+speedup of the SAME engine code on this host, and the projection becomes
+``device + max(host/threads, transfers)`` instead of the serial sum —
+with the measured speedup recorded next to the assumed thread count so
+the reader can discount the ideal-scaling part. The C++ Adam kernel is
+itself OpenMP-parallel, so on many-core TPU-VM hosts the host term shrinks
+with cores even at one pipeline thread; the pipeline's own win (measured
+here) is hiding D2H/H2D behind the kernels.
+"""
+import argparse
+import dataclasses
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_engine(overlap, threads, gas=2, bucket_mb=8):
+    from deepspeed_tpu.models import GPT2_CONFIGS, gpt2_init, gpt2_loss_fn
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    # Params-heavy, token-light: host Adam work scales with params, device
+    # compute with tokens — this shape keeps the host pipeline a visible
+    # slice of the step on a CPU "device".
+    cfg = dataclasses.replace(
+        GPT2_CONFIGS["gpt2-tiny"], hidden_size=512, num_heads=8,
+        num_layers=6, max_seq_length=64, vocab_size=2048,
+        hidden_dropout=0.0, attn_dropout=0.0)
+    micro_bs = 2
+    ds = {
+        "train_batch_size": micro_bs * gas,
+        "train_micro_batch_size_per_gpu": micro_bs,
+        "gradient_accumulation_steps": gas,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "overlap_comm": overlap,
+                              "offload_bucket_size": bucket_mb * 2 ** 20,
+                              "offload_host_threads": threads},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "steps_per_print": 10 ** 9,
+    }
+    mesh = build_mesh(devices=jax.devices()[:1])
+    engine = DeepSpeedEngine(model=gpt2_loss_fn(cfg),
+                             model_params=gpt2_init(jax.random.PRNGKey(0),
+                                                    cfg),
+                             config=ds, mesh=mesh)
+    batch = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(micro_bs * gas, cfg.max_seq_length + 1),
+        dtype=np.int32))
+    return engine, batch
+
+
+def run(overlap, threads, steps=8):
+    engine, batch = build_engine(overlap, threads)
+    for _ in range(2):                      # compile + staging warmup
+        engine.train_batch(batch)
+    jax.block_until_ready(engine.state.params)
+    keys = ("pipeline_span_ms", "pipeline_work_ms", "d2h_ms",
+            "host_norm_ms", "host_step_ms", "h2d_dispatch_ms")
+    acc = {k: 0.0 for k in keys}
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        engine.train_batch(batch)
+        for k in keys:
+            acc[k] += engine.offload_timings[k]
+    jax.block_until_ready(engine.state.params)
+    wall_ms = (time.perf_counter() - t0) / steps * 1e3
+    avg = {k: v / steps for k, v in acc.items()}
+    # Averaged over the measured steps (a single step's span/work ratio is
+    # noisy at CPU scale where the whole host pipeline is tens of ms).
+    frac = max(0.0, 1.0 - avg["pipeline_span_ms"] / avg["pipeline_work_ms"]) \
+        if overlap and avg["pipeline_work_ms"] > 0 else 0.0
+    rec = {
+        "mode": "overlap" if overlap else "serial",
+        "gas": engine.gradient_accumulation_steps(),
+        "host_threads": engine._offload.host_threads if overlap else 0,
+        "num_buckets": engine.offload_timings["num_buckets"],
+        "step_wall_ms": round(wall_ms, 2),
+        "host_pipeline_span_ms": round(avg["pipeline_span_ms"], 2),
+        "host_pipeline_work_ms": round(avg["pipeline_work_ms"], 2),
+        "overlap_fraction": round(frac, 4),
+        "d2h_ms": round(avg["d2h_ms"], 2),
+        "host_norm_ms": round(avg["host_norm_ms"], 2),
+        "host_step_ms": round(avg["host_step_ms"], 2),
+        "h2d_dispatch_ms": round(avg["h2d_dispatch_ms"], 2),
+    }
+    print(json.dumps(rec), flush=True)
+    return rec
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--record", action="store_true",
+                    help="merge measured overlap into OFFLOAD_BENCH.json")
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    cores = os.cpu_count() or 1
+    serial = run(False, 0, args.steps)
+    sweep = [run(True, t, args.steps)
+             for t in sorted({1, 2, cores})]
+    best = min(sweep, key=lambda r: r["step_wall_ms"])
+    speedup = serial["step_wall_ms"] / best["step_wall_ms"]
+    best_frac = max(r["overlap_fraction"] for r in sweep)
+    summary = {
+        "mode": "summary", "cores": cores,
+        "serial_step_ms": serial["step_wall_ms"],
+        "best_overlap_step_ms": best["step_wall_ms"],
+        "best_host_threads": best["host_threads"],
+        "measured_step_speedup": round(speedup, 3),
+        "best_overlap_fraction": best_frac,
+    }
+    print(json.dumps(summary), flush=True)
+
+    if args.record:
+        here = os.path.dirname(os.path.abspath(__file__))
+        path = os.path.join(here, "OFFLOAD_BENCH.json")
+        with open(path) as f:
+            rec = json.load(f)
+        # The 1.5B serial component measurements stay untouched; the
+        # overlapped projection re-shapes them with the measured pipeline.
+        device_ms = rec["offload_device_only_step_ms"]
+        host_ms = rec["offload_components_ms"]["host_step_ms"]
+        gbs = rec["projected_tpu_vm"]["assumed_host_link_gb_s"]
+        xfer_ms = 2 * rec["offload_transfer_bytes_each_way"] / (gbs * 1e9) \
+            * 1e3
+        serial_ms = device_ms + xfer_ms + host_ms
+        threads = best["host_threads"] or cores
+        proj_ms = device_ms + max(host_ms / max(1, threads), xfer_ms)
+        tokens = rec["offload_grad_accum_steps"] * 4 * 1024
+        rec["offload_overlap"] = {
+            "enabled": True,
+            "host_threads": threads,
+            "num_buckets_ablation": best["num_buckets"],
+            "overlap_fraction": best_frac,
+            "measured_step_speedup_this_host": summary[
+                "measured_step_speedup"],
+            "ablation_cores": cores,
+            # gas>1 evidence lives in the ablation runs (gas=2 pipeline,
+            # overlap vs serial); the preserved 1.5B component record
+            # above is the original gas=1 one-shot.
+            "ablation_gas": best["gas"],
+            "ablation": {"serial": serial, "sweep": sweep},
+        }
+        rec["projected_tpu_vm"] = {
+            "assumed_host_link_gb_s": gbs,
+            "step_ms": round(proj_ms, 1),
+            "tokens_per_sec": round(tokens / (proj_ms / 1e3), 1),
+            "serial_step_ms": round(serial_ms, 1),
+            "serial_tokens_per_sec": round(tokens / (serial_ms / 1e3), 1),
+            "formula": "device + max(host/threads, transfers)",
+            "host_threads_assumed": threads,
+        }
+        rec["note_overlap"] = (
+            "overlap fields measured by ablate_offload_overlap.py on this "
+            f"host ({cores} cores) with the same engine code at CPU scale "
+            f"(gas={best['gas']}, overlap vs serial, thread sweep); the "
+            "1.5B device/host/transfer components above are the original "
+            "tunneled-chip gas=1 one-shot. projected_tpu_vm now uses the "
+            "overlapped shape device + max(host/threads, transfers); "
+            "serial_step_ms preserves the old serial sum for comparison. "
+            "The SIMD Adam kernel is OpenMP-parallel, so host/threads "
+            "models TPU-VM many-core hosts; the measured per-step speedup "
+            f"and overlap_fraction on this {cores}-core box (where device "
+            "compute and host kernels contend for the same cores) are "
+            "recorded alongside as the honest lower bound.")
+        with open(path, "w") as f:
+            json.dump(rec, f, indent=1)
+        print(f"recorded -> {path}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

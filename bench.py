@@ -65,12 +65,18 @@ def chip_peak_tflops() -> float:
     return 197.0  # default to v5e if unknown TPU; CPU runs report vs this too
 
 
-def bench_offload_xl(gas: int = 1, n_steps: int = 2):
+def bench_offload_xl(gas: int = 1, n_steps: int = 2,
+                     overlap: bool = None, host_threads: int = None,
+                     bucket_mb: int = None):
     """North-star config (BASELINE.json): GPT-2 1.5B on ONE chip via
     ZeRO-Offload — full fp32 Adam state (17 GB) in host RAM, C++ SIMD Adam,
     bf16 grads D2H / params H2D each step. The reference's flagship
     ZeRO-Offload claim is exactly this shape of run (13B-on-one-V100,
     docs/_posts/2020-09-09-ZeRO-Offload.md:10).
+
+    ``overlap`` (default env DS_BENCH_OFFLOAD_OVERLAP, on) selects the
+    bucketed overlapped pipeline; False reproduces the serial numbers.
+    ``host_threads``/``bucket_mb`` map to the zero_optimization knobs.
 
     NOT run inside the default bench: on this dev harness the chip is
     reached through a tunnel whose D2H path measures ~0.03 GB/s (H2D ~1
@@ -84,6 +90,12 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
     from deepspeed_tpu.runtime.engine import DeepSpeedEngine
     from deepspeed_tpu.parallel.topology import build_mesh
 
+    if overlap is None:
+        overlap = os.environ.get("DS_BENCH_OFFLOAD_OVERLAP", "1") == "1"
+    if host_threads is None:
+        host_threads = int(os.environ.get("DS_BENCH_OFFLOAD_THREADS", "0"))
+    if bucket_mb is None:
+        bucket_mb = int(os.environ.get("DS_BENCH_OFFLOAD_BUCKET_MB", "64"))
     cfg = dataclasses.replace(
         GPT2_CONFIGS["gpt2-xl"], max_seq_length=1024,
         remat_policy="dots", hidden_dropout=0.0, attn_dropout=0.0,
@@ -106,7 +118,10 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
         "train_micro_batch_size_per_gpu": micro_bs,
         "gradient_accumulation_steps": gas,
         "bf16": {"enabled": True},
-        "zero_optimization": {"stage": 2, "cpu_offload": True},
+        "zero_optimization": {"stage": 2, "cpu_offload": True,
+                              "overlap_comm": overlap,
+                              "offload_bucket_size": bucket_mb * 2 ** 20,
+                              "offload_host_threads": host_threads},
         "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
         "steps_per_print": 10 ** 9,
     }
@@ -124,7 +139,14 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
     tokens_per_sec = micro_bs * gas * S / dt
     tflops = tokens_per_sec * gpt2_flops_per_token(cfg, S) / 1e12
     t = dict(engine.offload_timings or {})
-    comp_sum_ms = sum(t.values())
+    # Scalar phase components only (the per-bucket lists and pipeline
+    # metadata ride alongside, not in the reconciliation sum).
+    comp = {k: v for k, v in t.items()
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+            and k.endswith("_ms") and k not in
+            ("wall_ms", "pipeline_span_ms", "pipeline_work_ms",
+             "d2h_reshard_ms")}   # reshard is already folded into d2h_ms
+    comp_sum_ms = sum(comp.values())
 
     # Device-only step: params are resident and no H2D is pending after the
     # timed loop, so a bare grads pass fenced by the loss fetch is pure
@@ -150,7 +172,22 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
     # stated bandwidth. TPU-VM hosts measure >10 GB/s; 10 is conservative.
     vm_gbs = 10.0
     xfer_ms = 2 * grad_bytes / (vm_gbs * 1e9) * 1e3      # D2H + H2D
-    proj_ms = device_only_ms + xfer_ms + t.get("host_step_ms", 0.0)
+    host_work_ms = t.get("host_step_ms", 0.0) + t.get("host_norm_ms", 0.0)
+    serial_ms = device_only_ms + xfer_ms + host_work_ms
+    # Threads beyond this host's physical cores can't scale the host Adam
+    # (the projection models THIS host with a real link, so the local core
+    # count is the honest cap even if the knob asks for more).
+    threads = min(engine._offload.host_threads, os.cpu_count() or 1)
+    if overlap:
+        # Overlapped shape: transfers hide behind host Adam (or vice
+        # versa), host Adam spreads over the worker pool — device +
+        # max(host/threads, transfers), NOT the serial sum. The recorded
+        # overlap_fraction is the measured evidence that the pipeline
+        # actually hides work.
+        proj_ms = device_only_ms + max(host_work_ms / max(1, threads),
+                                       xfer_ms)
+    else:
+        proj_ms = serial_ms
     proj_tps = micro_bs * gas * S / (proj_ms / 1e3)
     return {
         "offload_model": f"gpt2-xl({n_params/1e9:.2f}B)",
@@ -158,14 +195,26 @@ def bench_offload_xl(gas: int = 1, n_steps: int = 2):
         "offload_tokens_per_sec": round(tokens_per_sec, 1),
         "offload_tflops_per_chip": round(tflops, 2),
         "offload_step_wall_ms": round(dt * 1e3, 1),
-        "offload_components_ms": {k: round(v, 1) for k, v in t.items()},
+        "offload_components_ms": {k: round(v, 1) for k, v in comp.items()},
         "offload_components_sum_ms": round(comp_sum_ms, 1),
         "offload_device_only_step_ms": round(device_only_ms, 1),
         "offload_transfer_bytes_each_way": grad_bytes,
+        "offload_overlap": {
+            "enabled": overlap,
+            "host_threads": threads,
+            "bucket_mb": bucket_mb,
+            "num_buckets": t.get("num_buckets", 1),
+            "overlap_fraction": round(t.get("overlap_fraction", 0.0), 4),
+            "pipeline_span_ms": round(t.get("pipeline_span_ms", 0.0), 1),
+            "pipeline_work_ms": round(t.get("pipeline_work_ms", 0.0), 1),
+        },
         "projected_tpu_vm": {
             "assumed_host_link_gb_s": vm_gbs,
             "step_ms": round(proj_ms, 1),
             "tokens_per_sec": round(proj_tps, 1),
+            "serial_step_ms": round(serial_ms, 1),
+            "formula": "device + max(host/threads, transfers)" if overlap
+                       else "device + transfers + host",
         },
     }
 

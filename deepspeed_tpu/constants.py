@@ -174,6 +174,14 @@ ZERO_LOAD_FROM_FP32_WEIGHTS = "load_from_fp32_weights"
 ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT = True
 ZERO_CPU_OFFLOAD = "cpu_offload"
 ZERO_CPU_OFFLOAD_DEFAULT = False
+# Offload overlap pipeline: the host masters are split into ~bucket_size-
+# byte groups (fp32 master bytes) so D2H, host Adam, and H2D stream
+# per-bucket; overlap_comm toggles the concurrent executor, host_threads
+# sizes its worker pool (0 = os.cpu_count()).
+ZERO_OFFLOAD_BUCKET_SIZE = "offload_bucket_size"
+ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT = 64 * 2 ** 20
+ZERO_OFFLOAD_HOST_THREADS = "offload_host_threads"
+ZERO_OFFLOAD_HOST_THREADS_DEFAULT = 0
 ZERO_ELASTIC_CHECKPOINT = "elastic_checkpoint"
 ZERO_ELASTIC_CHECKPOINT_DEFAULT = True
 ZERO_MAX_ELEMENTS_PER_COMM = "max_elements_per_comm"

@@ -145,9 +145,31 @@ class DeepSpeedCPUAdam:
         down-cast in the same pass (ds_adam_step_plus_copy parity).
         """
         self.step_count += 1
+        self.step_leaves(master_leaves, grad_leaves,
+                         range(len(master_leaves)), lr=lr,
+                         grad_scale=grad_scale, bf16_out=bf16_out,
+                         step=self.step_count)
+
+    def step_leaves(self, master_leaves, grad_leaves, indices,
+                    lr: Optional[float] = None, grad_scale: float = 1.0,
+                    bf16_out: Optional[list] = None,
+                    step: Optional[int] = None) -> None:
+        """Per-bucket Adam: update ``master_leaves[i]`` for ``i`` in
+        ``indices`` from ``grad_leaves[j]`` (the j-th grad pairs with the
+        j-th index), in place.
+
+        ``step`` is the bias-correction tick, passed EXPLICITLY so
+        concurrent per-bucket callers share one optimizer step without
+        racing on ``step_count`` — the bucketed offload pipeline updates
+        ``step_count`` once, after every bucket has applied. Leaves are
+        disjoint per bucket, so calls for different buckets are thread-safe
+        (the native kernels and numpy both release the GIL for the heavy
+        loops)."""
+        t = int(self.step_count if step is None else step)
         lr = self.lr if lr is None else float(lr)
         b1, b2 = self.betas
-        for i, (p, g) in enumerate(zip(master_leaves, grad_leaves)):
+        for j, i in enumerate(indices):
+            p, g = master_leaves[i], grad_leaves[j]
             assert p.dtype == np.float32 and p.flags["C_CONTIGUOUS"], \
                 "masters must be contiguous fp32"
             m, v = self.exp_avg[i], self.exp_avg_sq[i]
@@ -158,13 +180,13 @@ class DeepSpeedCPUAdam:
                 if bf16_out is not None:
                     self._lib.ds_adam_step_plus_copy_bf16g(
                         _ptr(p), _ptr(gb, _u16p), _ptr(m), _ptr(v),
-                        _ptr(bf16_out[i], _u16p), p.size, self.step_count,
+                        _ptr(bf16_out[i], _u16p), p.size, t,
                         lr, b1, b2, self.eps, self.weight_decay,
                         int(self.adamw_mode), grad_scale)
                 else:
                     self._lib.ds_adam_step_bf16g(
                         _ptr(p), _ptr(gb, _u16p), _ptr(m), _ptr(v), p.size,
-                        self.step_count, lr, b1, b2, self.eps,
+                        t, lr, b1, b2, self.eps,
                         self.weight_decay, int(self.adamw_mode), grad_scale)
                 continue
             g = np.ascontiguousarray(np.asarray(g, np.float32))
@@ -172,22 +194,21 @@ class DeepSpeedCPUAdam:
                 if bf16_out is not None:
                     self._lib.ds_adam_step_plus_copy(
                         _ptr(p), _ptr(g), _ptr(m), _ptr(v),
-                        _ptr(bf16_out[i], _u16p), p.size, self.step_count,
+                        _ptr(bf16_out[i], _u16p), p.size, t,
                         lr, b1, b2, self.eps, self.weight_decay,
                         int(self.adamw_mode), grad_scale)
                 else:
                     self._lib.ds_adam_step(
                         _ptr(p), _ptr(g), _ptr(m), _ptr(v), p.size,
-                        self.step_count, lr, b1, b2, self.eps,
+                        t, lr, b1, b2, self.eps,
                         self.weight_decay, int(self.adamw_mode), grad_scale)
             else:
-                self._numpy_step(p, g, m, v, lr, grad_scale)
+                self._numpy_step(p, g, m, v, lr, grad_scale, t)
                 if bf16_out is not None:
                     bf16_out[i][...] = _f32_to_bf16_np(p)
 
-    def _numpy_step(self, p, g, m, v, lr, grad_scale) -> None:
+    def _numpy_step(self, p, g, m, v, lr, grad_scale, t) -> None:
         b1, b2 = self.betas
-        t = self.step_count
         g = g * grad_scale
         if not self.adamw_mode and self.weight_decay:
             g = g + self.weight_decay * p
@@ -202,8 +223,13 @@ class DeepSpeedCPUAdam:
             p -= lr * self.weight_decay * p
         p -= (lr / bc1) * (m / denom)
 
-    def grad_norm(self, grad_leaves, grad_scale: float = 1.0) -> float:
-        """Global L2 norm of the (scaled) gradients, host-side."""
+    def grad_norm_sq(self, grad_leaves, grad_scale: float = 1.0) -> float:
+        """Squared L2 norm of the (scaled) gradients, accumulated per leaf
+        in list order (float64 partials). The per-bucket entry point: the
+        bucketed offload path sums these partials in bucket-index order, so
+        overlapped and serial execution of the SAME bucketing produce the
+        identical double — the overflow vote and clip coefficient cannot
+        diverge between the two modes."""
         acc = 0.0
         for g in grad_leaves:
             if self._lib is not None and _is_bf16(g):
@@ -218,7 +244,11 @@ class DeepSpeedCPUAdam:
             else:
                 gd = g.astype(np.float64) * grad_scale
                 acc += float(np.sum(gd * gd))
-        return float(np.sqrt(acc))
+        return acc
+
+    def grad_norm(self, grad_leaves, grad_scale: float = 1.0) -> float:
+        """Global L2 norm of the (scaled) gradients, host-side."""
+        return float(np.sqrt(self.grad_norm_sq(grad_leaves, grad_scale)))
 
 
 def _f32_to_bf16_np(a: np.ndarray) -> np.ndarray:

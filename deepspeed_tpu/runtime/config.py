@@ -340,6 +340,11 @@ class DeepSpeedConfig:
             raise DeepSpeedConfigError(
                 f"ZeRO stage {self.zero_optimization_stage} > max "
                 f"{C.MAX_STAGE_ZERO_OPTIMIZATION}")
+        if self.zero_config.overlap_comm and not self.zero_enabled:
+            logger.warning(
+                f"{C.ZERO_OVERLAP_COMM} is set but zero_optimization is "
+                "disabled — it only affects the ZeRO paths (for "
+                "cpu_offload it selects the bucketed overlapped pipeline)")
         if self.optimizer_name is not None and \
                 self.optimizer_name not in C.DEEPSPEED_OPTIMIZERS:
             logger.warning(

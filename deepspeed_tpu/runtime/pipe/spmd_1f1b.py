@@ -76,6 +76,36 @@ from ...parallel.topology import PP_AXIS
 from .spmd import _split_batch, _to_micro
 
 
+def tick_table(num_micro: int, num_stages: int):
+    """The scan's schedule AS DATA: ``table[t][r]`` lists the work items
+    tick ``t``'s gates admit on stage ``r`` — ``("F", m)`` stage forward,
+    ``("H", m)`` head loss + its grad (last stage, same tick as its
+    forward), ``("B", m)`` stage backward. Exactly the clock the scan body
+    runs (``f = t - r``, ``h = t - (P-1)``, ``b = t - 2(P-1) + r``; module
+    docstring), exported so ``runtime/pipe/schedule.py``'s TrainSchedule —
+    the reference's instruction-list specification — can be asserted
+    against it as the 1F1B oracle (tests/test_pipe_1f1b.py)."""
+    M, Pstages = num_micro, num_stages
+    last = Pstages - 1
+    table = []
+    for t in range(M + 2 * last):
+        per_stage = []
+        for r in range(Pstages):
+            evs = []
+            f = t - r
+            if 0 <= f < M:
+                evs.append(("F", f))
+            h = t - last
+            if r == last and 0 <= h < M:
+                evs.append(("H", h))
+            b = t - 2 * last + r
+            if 0 <= b < M:
+                evs.append(("B", b))
+            per_stage.append(evs)
+        table.append(per_stage)
+    return table
+
+
 def spmd_pipeline_1f1b_grads(embed_fn: Callable, stage_fn: Callable,
                              head_fn: Callable, num_stages: int,
                              num_micro_batches: int, mesh: Mesh,

@@ -7,9 +7,13 @@ cpu_offload, elastic_checkpoint (zero/config.py:61-107); legacy bool→dict
 migration (zero/config.py:36-53).
 
 TPU mapping notes: bucket sizes become scan-chunk hints for the sharded
-update; ``overlap_comm`` is advisory (XLA's latency-hiding scheduler overlaps
-reduce-scatter with backward automatically); ``cpu_offload`` moves optimizer
-state to TPU-VM host RAM.
+update; for the device collectives ``overlap_comm`` is advisory (XLA's
+latency-hiding scheduler overlaps reduce-scatter with backward
+automatically); ``cpu_offload`` moves optimizer state to TPU-VM host RAM,
+and there ``overlap_comm`` is load-bearing: it selects the bucketed
+overlapped offload pipeline (D2H / host Adam / H2D streamed per
+``offload_bucket_size`` bucket through an ``offload_host_threads`` worker
+pool) over the serial fetch-step-upload path.
 """
 from __future__ import annotations
 
@@ -30,6 +34,8 @@ class ZeroConfig:
         self.overlap_comm = C.ZERO_OVERLAP_COMM_DEFAULT
         self.load_from_fp32_weights = C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT
         self.cpu_offload = C.ZERO_CPU_OFFLOAD_DEFAULT
+        self.offload_bucket_size = C.ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT
+        self.offload_host_threads = C.ZERO_OFFLOAD_HOST_THREADS_DEFAULT
         self.elastic_checkpoint = C.ZERO_ELASTIC_CHECKPOINT_DEFAULT
         self.max_elements_per_comm = C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT
 
@@ -58,8 +64,22 @@ class ZeroConfig:
         self.load_from_fp32_weights = get(d, C.ZERO_LOAD_FROM_FP32_WEIGHTS,
                                           C.ZERO_LOAD_FROM_FP32_WEIGHTS_DEFAULT)
         self.cpu_offload = get(d, C.ZERO_CPU_OFFLOAD, C.ZERO_CPU_OFFLOAD_DEFAULT)
+        self.offload_bucket_size = get(d, C.ZERO_OFFLOAD_BUCKET_SIZE,
+                                       C.ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT)
+        self.offload_host_threads = get(d, C.ZERO_OFFLOAD_HOST_THREADS,
+                                        C.ZERO_OFFLOAD_HOST_THREADS_DEFAULT)
         self.elastic_checkpoint = get(d, C.ZERO_ELASTIC_CHECKPOINT,
                                       C.ZERO_ELASTIC_CHECKPOINT_DEFAULT)
+        if not isinstance(self.offload_bucket_size, int) \
+                or self.offload_bucket_size <= 0:
+            raise ValueError(
+                f"{C.ZERO_OFFLOAD_BUCKET_SIZE} must be a positive byte "
+                f"count, got {self.offload_bucket_size!r}")
+        if not isinstance(self.offload_host_threads, int) \
+                or self.offload_host_threads < 0:
+            raise ValueError(
+                f"{C.ZERO_OFFLOAD_HOST_THREADS} must be a non-negative int "
+                f"(0 = auto), got {self.offload_host_threads!r}")
         self.max_elements_per_comm = get(d, C.ZERO_MAX_ELEMENTS_PER_COMM,
                                          C.ZERO_MAX_ELEMENTS_PER_COMM_DEFAULT)
         if not isinstance(self.stage, int) or not (0 <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):

@@ -7,15 +7,36 @@ live in host numpy arrays, updated by the C++ SIMD kernel
 (ops/cpu_adam.py), and the updated params return to HBM as a bf16 staging
 buffer produced in the same pass (ds_adam_step_plus_copy parity).
 
-Per step: device computes loss-scaled fp32 grads (dp-sharded under stage 2)
-→ D2H → host computes the global grad norm (overflow vote + clip coeff,
-stage2.py:1371-1411 semantics) → SIMD Adam on the masters → H2D of the
-compute-dtype params. The H2D transfer is dispatched asynchronously
-(jax.device_put returns immediately); the next step's forward overlaps it.
+The step is BUCKETED: the flat master-leaf list is split into contiguous
+~``offload_bucket_size``-byte groups (the reference's per-bucket async
+copies, stage2.py:775-873), and one step is a two-phase protocol over
+those buckets:
+
+  phase 1 (norm):  per-bucket squared grad norms accumulate as bucket
+                   grads land on the host; once every bucket is in, the
+                   global norm resolves the fp16 overflow vote and the
+                   clip coefficient (stage2.py:1371-1411 semantics) —
+                   until then NO master or moment may mutate, so an
+                   overflow step leaves every bucket untouched;
+  phase 2 (apply): per-bucket SIMD Adam (explicit bias-correction tick
+                   shared by all buckets) + the bucket's compute-dtype
+                   upload leaves, released bucket-by-bucket.
+
+``run_bucketed_step`` executes the protocol either serially (the parity
+baseline: fetch → norm → vote → apply → upload, bucket by bucket, each
+transfer individually fenced) or overlapped: the caller's thread streams
+bucket fetches (D2H waits) while a ``ThreadPoolExecutor`` runs the norm
+kernels, then runs Adam per bucket in the pool and hands each finished
+bucket back for immediate async H2D. Norms pipeline with D2H; applies
+pipeline with H2D; device compute of the next step overlaps the tail.
+Both modes walk buckets in index order for every floating-point
+accumulation, so their masters/moments/params are bit-identical.
 """
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, Optional, Tuple
+import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -41,6 +62,34 @@ def _partition_axis(shape, num: int) -> Optional[int]:
     return None
 
 
+def _partition_buckets(leaf_nbytes: List[int], bucket_bytes: int) \
+        -> List[List[int]]:
+    """Contiguous leaf-index groups of ~``bucket_bytes`` each (greedy fill;
+    a single oversized leaf gets its own bucket). Contiguity in flatten
+    order keeps the device grad outputs, the host masters/moments, and the
+    bf16 staging views all indexable by the same bucket lists."""
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, nb in enumerate(leaf_nbytes):
+        if cur and cur_bytes + nb > bucket_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
+def grad_to_host(g) -> np.ndarray:
+    """Device grad leaf -> host array the SIMD kernels accept: bf16 stays
+    bf16 (the native Adam/norm kernels widen inline — no host cast pass,
+    half the gradient read traffic), everything else becomes fp32."""
+    a = np.asarray(g)
+    return a if _is_bf16(a) else np.asarray(a, np.float32)
+
+
 class ZeroOffloadOptimizer:
     """Host-side optimizer state + step for the engine's offload path.
 
@@ -58,7 +107,8 @@ class ZeroOffloadOptimizer:
                  fp16: bool = False, scaler_cfg: Optional[Dict] = None,
                  partition_rank: int = 0, partition_num: int = 1,
                  axis_divisor: Optional[int] = None,
-                 sumsq_allreduce: Optional[Callable[[float], float]] = None):
+                 sumsq_allreduce: Optional[Callable[[float], float]] = None,
+                 bucket_bytes: int = 0, host_threads: int = 0):
         """``axis_divisor``: divisibility used to PICK each leaf's partition
         axis (defaults to partition_num). The multi-host engine passes the
         dp degree here so the host partition axis coincides with the axis
@@ -68,7 +118,11 @@ class ZeroOffloadOptimizer:
         ``sumsq_allreduce``: cross-rank sum of the partition-local squared
         grad norm; required for correct clipping when partition_num > 1
         (each rank sees only its shard — without the reduction the clip
-        coefficients diverge and replicated leaves drift)."""
+        coefficients diverge and replicated leaves drift).
+
+        ``bucket_bytes``: target bucket size in fp32-master bytes (0 =
+        ``constants.ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT``). ``host_threads``:
+        worker-pool width for the overlapped executor (0 = os.cpu_count())."""
         name = (opt_name or C.ADAM_OPTIMIZER).lower()
         if name not in SUPPORTED:
             raise ValueError(
@@ -120,12 +174,25 @@ class ZeroOffloadOptimizer:
         self.step_count = 0
         self.skipped_steps = 0
 
+        # Bucketed two-phase step state (see module docstring).
+        import os
+        self.bucket_bytes = int(bucket_bytes) or \
+            C.ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT
+        self.host_threads = int(host_threads) or (os.cpu_count() or 1)
+        self.buckets = _partition_buckets(
+            [m.nbytes for m in self.masters], self.bucket_bytes)
+        self._pending_t: Optional[int] = None
+        self._pool: Optional[ThreadPoolExecutor] = None
+
         nbytes = sum(m.nbytes for m in self.masters) + \
             sum(a.nbytes for a in self.opt.exp_avg) + \
             sum(a.nbytes for a in self.opt.exp_avg_sq)
-        log_dist(f"ZeRO-Offload: {len(self.masters)} tensors, "
+        log_dist(f"ZeRO-Offload: {len(self.masters)} tensors in "
+                 f"{len(self.buckets)} bucket(s) "
+                 f"(~{self.bucket_bytes / 2**20:.0f} MiB), "
                  f"{nbytes / 2**20:.1f} MiB optimizer state in host RAM "
-                 f"(native SIMD: {self.opt.native})", ranks=[0])
+                 f"(native SIMD: {self.opt.native}, "
+                 f"host threads: {self.host_threads})", ranks=[0])
 
     # ------------------------------------------------------------------ #
     def local_param_leaves(self):
@@ -167,66 +234,130 @@ class ZeroOffloadOptimizer:
         return leaf[tuple(sl)]
 
     # ------------------------------------------------------------------ #
-    def host_step(self, grads: Any) -> Dict[str, float]:
-        """One optimizer step from device-computed (loss-scaled) grads.
+    # Bucketed two-phase step protocol
+    # ------------------------------------------------------------------ #
+    def num_buckets(self) -> int:
+        return len(self.buckets)
 
-        Grad leaves may be full-shaped (sliced here to the local partition)
-        or already partition-local."""
-        # bf16 grads stay bf16: the native Adam/norm kernels widen inline
-        # (ops/cpu_adam.py), which removes a full-tree host cast pass and
-        # halves the gradient read traffic on the offload host.
-        def to_host(g):
-            a = np.asarray(g)
-            return a if _is_bf16(a) else np.asarray(a, np.float32)
+    def ensure_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            import weakref
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.host_threads,
+                thread_name_prefix="ds-offload")
+            # Engines have no teardown hook: reap the idle workers when
+            # the optimizer is collected (thread sweeps / test suites
+            # build many engines per process).
+            weakref.finalize(self, self._pool.shutdown, wait=False)
+        return self._pool
 
-        g_leaves = [self.slice_leaf(i, to_host(g))
-                    for i, g in enumerate(jax.tree_util.tree_leaves(grads))]
+    def bucket_sumsq(self, b: int, g_local) -> Tuple[float, float]:
+        """Phase-1 norm for bucket ``b``: (partitioned, replicated) squared
+        norm partials over its partition-local grad leaves, per-leaf in
+        bucket order. Partitioned leaves are DISJOINT shards whose partials
+        sum across ranks; replicated leaves are identical everywhere and
+        contribute once, outside the reduction — the reference
+        stage2.py:1371-1411 partition-then-allreduce decomposition."""
         inv_scale = 1.0 / self.loss_scale
-        if self.partition_num > 1:
-            # Partitioned leaves: every rank holds a DISJOINT shard, so the
-            # local squared norms sum across ranks. Replicated leaves are
-            # identical everywhere and contribute once, outside the
-            # reduction. Same decomposition as reference
-            # stage2.py:1371-1411's partition-then-allreduce norm.
-            part = [g for i, g in enumerate(g_leaves)
-                    if self._axes[i] is not None]
-            repl = [g for i, g in enumerate(g_leaves)
-                    if self._axes[i] is None]
-            local_sumsq = self.opt.grad_norm(part, inv_scale) ** 2
-            if self.sumsq_allreduce is not None:
-                total_sumsq = float(self.sumsq_allreduce(local_sumsq))
-            elif self.clip > 0 or self.fp16:
-                # Norm DRIVES behavior (clip coeff / overflow vote): a
-                # partition-local value would diverge across ranks and
-                # drift the replicated leaves apart.
-                raise RuntimeError(
-                    "partition_num > 1 with gradient clipping or fp16 "
-                    "requires sumsq_allreduce (cross-rank norm reduction)")
+        part = repl = 0.0
+        for leaf_i, g in zip(self.buckets[b], g_local):
+            s = self.opt.grad_norm_sq([g], inv_scale)
+            if self._axes[leaf_i] is not None:
+                part += s
             else:
-                total_sumsq = local_sumsq      # metric-only
-            total_sumsq += self.opt.grad_norm(repl, inv_scale) ** 2
-            grad_norm = float(np.sqrt(total_sumsq))
-        else:
-            grad_norm = self.opt.grad_norm(g_leaves, inv_scale)
-        overflow = self.fp16 and not np.isfinite(grad_norm)
+                repl += s
+        return part, repl
 
+    def resolve_vote(self, part_sumsqs, repl_sumsqs) -> Dict[str, float]:
+        """Resolve the global norm once every bucket's partials are in
+        (lists indexed by bucket — ALWAYS summed in bucket order, so
+        overlapped completion order cannot perturb the double). Runs the
+        overflow vote + loss-scale state machine and computes the clip
+        coefficient; on overflow no bucket may apply (masters/moments
+        untouched). Returns the step metrics; phase 2 reads
+        ``clip_coeff``/``lr`` from them."""
+        local_part = 0.0
+        for s in part_sumsqs:
+            local_part += s
+        if self.sumsq_allreduce is not None:
+            total = float(self.sumsq_allreduce(local_part))
+        elif self.partition_num > 1 and (self.clip > 0 or self.fp16):
+            # Norm DRIVES behavior (clip coeff / overflow vote): a
+            # partition-local value would diverge across ranks and
+            # drift the replicated leaves apart.
+            raise RuntimeError(
+                "partition_num > 1 with gradient clipping or fp16 "
+                "requires sumsq_allreduce (cross-rank norm reduction)")
+        else:
+            total = local_part                 # metric-only when sharded
+        for s in repl_sumsqs:
+            total += s
+        grad_norm = float(np.sqrt(total))
+        overflow = self.fp16 and not np.isfinite(grad_norm)
         if overflow:
             self.skipped_steps += 1
             self._scale_down()
             return {"loss_scale": self.loss_scale, "grad_norm": grad_norm,
-                    "overflow": True, "lr": self._lr()}
-
+                    "overflow": True, "lr": self._lr(), "clip_coeff": 1.0}
         coeff = 1.0
         if self.clip > 0 and np.isfinite(grad_norm) and grad_norm > self.clip:
             coeff = self.clip / (grad_norm + 1e-6)
-        lr = self._lr()
-        self.opt.step(self.masters, g_leaves, lr=lr,
-                      grad_scale=inv_scale * coeff,
-                      bf16_out=self._bf16_staging)
+        # All buckets share ONE bias-correction tick; step_count advances
+        # in finish_step, after the last bucket applied.
+        self._pending_t = self.opt.step_count + 1
+        return {"loss_scale": self.loss_scale, "grad_norm": grad_norm,
+                "overflow": False, "lr": self._lr(), "clip_coeff": coeff}
+
+    def bucket_apply(self, b: int, g_local, lr: float, clip_coeff: float,
+                     want_upload: bool = True) -> Optional[list]:
+        """Phase-2 Adam for bucket ``b`` (in place, explicit shared tick),
+        then return its upload-ready compute-dtype host leaves (bf16: the
+        kernel's fused staging down-cast, zero extra passes; skipped when
+        the caller uploads the whole tree afterwards). Buckets touch
+        disjoint leaves — safe to run concurrently."""
+        assert self._pending_t is not None, \
+            "bucket_apply before resolve_vote (or after an overflow vote)"
+        self.opt.step_leaves(
+            self.masters, g_local, self.buckets[b], lr=lr,
+            grad_scale=(1.0 / self.loss_scale) * clip_coeff,
+            bf16_out=self._bf16_staging, step=self._pending_t)
+        return self.upload_leaves(self.buckets[b]) if want_upload else None
+
+    def finish_step(self) -> None:
+        """Commit the step after every bucket applied: advance the shared
+        optimizer tick, then run the loss-scale growth side of the state
+        machine."""
+        assert self._pending_t is not None
+        self.opt.step_count = self._pending_t
+        self._pending_t = None
         self.step_count += 1
         self._scale_up()
-        return {"loss_scale": self.loss_scale, "grad_norm": grad_norm,
-                "overflow": False, "lr": lr}
+
+    def upload_leaves(self, idxs) -> list:
+        """Compute-dtype host leaves for the given indices (same source
+        buffers as local_param_leaves, per bucket)."""
+        if self.compute_dtype == jnp.bfloat16:
+            import ml_dtypes
+            return [self._bf16_staging[i].view(ml_dtypes.bfloat16)
+                    for i in idxs]
+        dt = np.dtype(self.compute_dtype)
+        return [self.masters[i].astype(dt) for i in idxs]
+
+    # ------------------------------------------------------------------ #
+    def host_step(self, grads: Any) -> Dict[str, float]:
+        """One optimizer step from device-computed (loss-scaled) grads —
+        the serial execution of the bucketed protocol (the engine's
+        overlapped path drives run_bucketed_step itself, with device
+        fetch/upload callbacks).
+
+        Grad leaves may be full-shaped (sliced here to the local partition)
+        or already partition-local."""
+        g_leaves = [self.slice_leaf(i, grad_to_host(g))
+                    for i, g in enumerate(jax.tree_util.tree_leaves(grads))]
+        metrics, _ = run_bucketed_step(
+            self, lambda b: [g_leaves[i] for i in self.buckets[b]],
+            overlap=False)
+        return metrics
 
     def _lr(self) -> float:
         return float(self.schedule_fn(self.step_count))
@@ -283,3 +414,113 @@ class ZeroOffloadOptimizer:
         if self._bf16_staging is not None:
             for buf, m in zip(self._bf16_staging, self.masters):
                 buf[...] = _f32_to_bf16_np(m)
+
+
+# --------------------------------------------------------------------- #
+# Bucketed step executor: serial parity baseline OR overlapped pipeline
+# --------------------------------------------------------------------- #
+def run_bucketed_step(off: ZeroOffloadOptimizer,
+                      fetch_bucket: Callable[[int], list],
+                      upload_bucket: Optional[Callable[[int, list], None]]
+                      = None,
+                      overlap: bool = False) \
+        -> Tuple[Dict[str, float], Dict[str, Any]]:
+    """Execute one two-phase bucketed offload step over ``off``.
+
+    ``fetch_bucket(b)`` blocks until bucket ``b``'s partition-local host
+    grad leaves are materialized (the D2H wait — for the serial path each
+    call is its own fence: nothing else is in flight, so the per-bucket
+    timing cannot bleed). ``upload_bucket(b, leaves)`` dispatches the
+    bucket's async H2D; it is always invoked on the caller's thread (jax
+    dispatch stays single-threaded), in bucket order when serial and in
+    Adam-completion order when overlapped.
+
+    Overlapped dataflow (``overlap=True``, pool width =
+    ``off.host_threads``):
+
+        caller thread:  fetch b0 | fetch b1 | ... | upload as applies land
+        worker pool:         norm b0 | norm b1 | ...  [vote]  adam b*
+
+    Floating-point accumulations (norm partials) are reduced in
+    bucket-index order in both modes, and every bucket's Adam shares one
+    explicit bias-correction tick, so serial and overlapped execution
+    produce bit-identical masters, moments, and uploads.
+
+    Returns ``(metrics, timings)`` — timings carry per-bucket fenced
+    ``d2h_ms``/``norm_ms``/``adam_ms``/``h2d_ms`` lists plus phase sums,
+    the host-pipeline span, and the span-vs-work ``overlap_fraction``
+    (0 when serial; > 0 exactly when concurrency hid host work)."""
+    nb = off.num_buckets()
+    pb: Dict[str, List[float]] = {
+        "d2h_ms": [0.0] * nb, "norm_ms": [0.0] * nb,
+        "adam_ms": [0.0] * nb, "h2d_ms": [0.0] * nb}
+    parts = [0.0] * nb
+    repls = [0.0] * nb
+    host_grads: List[Optional[list]] = [None] * nb
+    t_start = time.perf_counter()
+
+    def fetch(b: int) -> None:
+        t0 = time.perf_counter()
+        host_grads[b] = fetch_bucket(b)
+        pb["d2h_ms"][b] = (time.perf_counter() - t0) * 1e3
+
+    def norm(b: int) -> None:
+        t0 = time.perf_counter()
+        parts[b], repls[b] = off.bucket_sumsq(b, host_grads[b])
+        pb["norm_ms"][b] = (time.perf_counter() - t0) * 1e3
+
+    def adam(b: int, lr: float, coeff: float) -> Optional[list]:
+        t0 = time.perf_counter()
+        out = off.bucket_apply(b, host_grads[b], lr, coeff,
+                               want_upload=upload_bucket is not None)
+        pb["adam_ms"][b] = (time.perf_counter() - t0) * 1e3
+        return out
+
+    def upload(b: int, leaves: list) -> None:
+        if upload_bucket is None:
+            return
+        t0 = time.perf_counter()
+        upload_bucket(b, leaves)
+        pb["h2d_ms"][b] = (time.perf_counter() - t0) * 1e3
+
+    if not overlap:
+        for b in range(nb):
+            fetch(b)
+            norm(b)
+        metrics = off.resolve_vote(parts, repls)
+        if not metrics["overflow"]:
+            for b in range(nb):
+                upload(b, adam(b, metrics["lr"], metrics["clip_coeff"]))
+            off.finish_step()
+    else:
+        pool = off.ensure_pool()
+        norm_futs = []
+        for b in range(nb):
+            fetch(b)                      # D2H wait on the caller's thread
+            norm_futs.append(pool.submit(norm, b))   # ...norms in the pool
+        for f in norm_futs:
+            f.result()
+        metrics = off.resolve_vote(parts, repls)
+        if not metrics["overflow"]:
+            lr, coeff = metrics["lr"], metrics["clip_coeff"]
+            futs = {pool.submit(adam, b, lr, coeff): b for b in range(nb)}
+            for f in as_completed(futs):  # H2D the moment a bucket lands
+                upload(futs[f], f.result())
+            off.finish_step()
+
+    span_ms = (time.perf_counter() - t_start) * 1e3
+    work_ms = sum(sum(v) for v in pb.values())
+    timings = {
+        "per_bucket": pb,
+        "d2h_ms": sum(pb["d2h_ms"]),
+        "host_norm_ms": sum(pb["norm_ms"]),
+        "host_step_ms": sum(pb["adam_ms"]),
+        "h2d_dispatch_ms": sum(pb["h2d_ms"]),
+        "pipeline_span_ms": span_ms,
+        "pipeline_work_ms": work_ms,
+        "overlap_fraction": max(0.0, 1.0 - span_ms / work_ms)
+        if overlap and work_ms > 0 else 0.0,
+        "num_buckets": nb,
+        "overlapped": bool(overlap),
+    }
+    return metrics, timings

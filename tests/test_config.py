@@ -155,6 +155,32 @@ class TestZeroConfig:
         cfg = make_cfg({"train_batch_size": 8, "zero_optimization": True})
         assert cfg.zero_optimization_stage == 1
 
+    def test_offload_overlap_knobs(self):
+        from deepspeed_tpu import constants as C
+        cfg = make_cfg({"train_batch_size": 8,
+                        "zero_optimization": {
+                            "stage": 2, "cpu_offload": True,
+                            "overlap_comm": True,
+                            "offload_bucket_size": 1 << 20,
+                            "offload_host_threads": 3}})
+        assert cfg.zero_config.overlap_comm
+        assert cfg.zero_config.offload_bucket_size == 1 << 20
+        assert cfg.zero_config.offload_host_threads == 3
+        # defaults: serial off, ~64 MB buckets, auto threads
+        dflt = make_cfg({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 2,
+                                               "cpu_offload": True}})
+        assert not dflt.zero_config.overlap_comm
+        assert dflt.zero_config.offload_bucket_size == \
+            C.ZERO_OFFLOAD_BUCKET_SIZE_DEFAULT
+        assert dflt.zero_config.offload_host_threads == 0
+        for bad in [{"offload_bucket_size": 0},
+                    {"offload_bucket_size": -4},
+                    {"offload_host_threads": -1}]:
+            with pytest.raises(ValueError):
+                make_cfg({"train_batch_size": 8,
+                          "zero_optimization": {"stage": 2, **bad}})
+
     def test_invalid_stage(self):
         with pytest.raises(ValueError):
             make_cfg({"train_batch_size": 8, "zero_optimization": {"stage": 9}})

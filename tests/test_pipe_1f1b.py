@@ -111,6 +111,86 @@ class Test1F1BMemory:
             "sanity: the GPipe program should bank [M, mb, S, H]"
 
 
+class Test1F1BScheduleOracle:
+    """TrainSchedule (runtime/pipe/schedule.py) is the reference's
+    instruction-list specification of 1F1B; the production scan
+    (spmd_1f1b) runs a closed-form clock. These tests generate the
+    expected tick table FROM TrainSchedule and assert the scan's schedule
+    against it — the schedule module is the oracle, not a test-only
+    artifact.
+
+    The mapping: TrainSchedule alternates forward/backward family ticks
+    (one instruction family per stage per tick), the scan fuses both
+    families into one tick (forward sub-tick + backward sub-tick), so a
+    schedule tick ``u`` compresses 2:1 onto a scan tick ``t``:
+
+        forward  of micro m on stage s:  u = 2m + s        t = m + s
+                                         =>  u = 2t - s
+        backward of micro m on stage s:  u = 2m + 2P-1-s   t = m + 2(P-1)-s
+                                         =>  t = (u + 2P - 3 - s) / 2
+    """
+
+    @pytest.mark.parametrize("M,P", [(4, 2), (3, 2), (4, 4), (8, 4),
+                                     (6, 3), (2, 2)])
+    def test_scan_clock_matches_train_schedule(self, M, P):
+        from deepspeed_tpu.runtime.pipe.schedule import train_schedule_events
+        from deepspeed_tpu.runtime.pipe.spmd_1f1b import tick_table
+
+        events = train_schedule_events(M, P)
+        table = tick_table(M, P)
+        assert len(table) == M + 2 * (P - 1)       # scan tick count
+        # schedule tick count: 2 per micro + fill/drain
+        assert 1 + max(u for evs in events.values() for u, _, _ in evs) \
+            == 2 * (M + P - 1)
+
+        # Build the EXPECTED tick table from TrainSchedule's instruction
+        # stream via the 2:1 compression, then require the scan's table to
+        # match it exactly (modulo the head entries, asserted separately).
+        expected = [[[] for _ in range(P)] for _ in range(M + 2 * (P - 1))]
+        for s in range(P):
+            for u, kind, m in events[s]:
+                if kind == "F":
+                    t = (u + s) // 2
+                    assert (u + s) % 2 == 0, (u, s)
+                else:
+                    t = (u + 2 * P - 3 - s) // 2
+                    assert (u + 2 * P - 3 - s) % 2 == 0, (u, s)
+                expected[t][s].append((kind, m))
+        got = [[[e for e in table[t][s] if e[0] != "H"] for s in range(P)]
+               for t in range(len(table))]
+        # Within a scan tick the body runs the forward sub-tick first;
+        # normalize the oracle to the same intra-tick order (the pair is
+        # dataflow-independent: B consumes last tick's ppermuted cotangent).
+        expected = [[sorted(cell) for cell in row] for row in expected]
+        got = [[sorted(cell) for cell in row] for row in got]
+        assert got == expected
+
+    @pytest.mark.parametrize("M,P", [(4, 2), (8, 4), (6, 3)])
+    def test_scan_clock_structural_claims(self, M, P):
+        """Claims the executor enforces imperatively, restated against the
+        closed-form table: each send lands exactly one tick before its
+        recv (ppermute latency 1), and the head runs in the SAME tick as
+        the last stage's forward of that micro (backward starts the tick
+        its forward chain allows)."""
+        from deepspeed_tpu.runtime.pipe.spmd_1f1b import tick_table
+        table = tick_table(M, P)
+
+        def tick_of(kind, m, s):
+            hits = [t for t in range(len(table))
+                    if (kind, m) in table[t][s]]
+            assert len(hits) == 1, (kind, m, s, hits)
+            return hits[0]
+
+        for m in range(M):
+            for s in range(P - 1):
+                assert tick_of("F", m, s + 1) == tick_of("F", m, s) + 1
+            for s in range(P - 1, 0, -1):
+                assert tick_of("B", m, s - 1) == tick_of("B", m, s) + 1
+            assert tick_of("H", m, P - 1) == tick_of("F", m, P - 1)
+            # 1F1B: the last stage's backward shares its forward's tick
+            assert tick_of("B", m, P - 1) == tick_of("F", m, P - 1)
+
+
 def _1f1b_ds_config(**over):
     ds = {"train_batch_size": 32,
           "train_micro_batch_size_per_gpu": 2,

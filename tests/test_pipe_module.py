@@ -9,6 +9,9 @@ import deepspeed_tpu
 from deepspeed_tpu.runtime.pipe.module import (PipelineModule, LayerSpec,
                                                TiedLayerSpec)
 
+from capability import (PARTIAL_AUTO_SKIP_REASON,
+                        partial_auto_shard_map_supported)
+
 
 class Dense:
     """Minimal flax-style layer for tests."""
@@ -123,6 +126,8 @@ class TestPipelineEngineSingleStage:
 
 
 class TestToPipeSpec:
+    @pytest.mark.skipif(not partial_auto_shard_map_supported(),
+                        reason=PARTIAL_AUTO_SKIP_REASON)
     def test_uniform_module_runs_pp2(self):
         """to_pipe_spec: a uniform PipelineModule trains on a pp=2 mesh via
         the compiled SPMD pipeline and matches the pp=1 fused trajectory."""

@@ -36,7 +36,11 @@ def main():
     h2d, d2h = measure_tunnel()
     print(f"tunnel: H2D {h2d} GB/s, D2H {d2h} GB/s", flush=True)
     from bench import bench_offload_xl
-    extra = bench_offload_xl(gas=1, n_steps=int(os.environ.get('DS_OFFLOAD_STEPS', '1')))
+    # DS_BENCH_OFFLOAD_OVERLAP / _THREADS / _BUCKET_MB select the bucketed
+    # overlapped pipeline (default on); DS_BENCH_OFFLOAD_OVERLAP=0 records
+    # the serial baseline for the parity comparison.
+    extra = bench_offload_xl(gas=int(os.environ.get('DS_OFFLOAD_GAS', '1')),
+                             n_steps=int(os.environ.get('DS_OFFLOAD_STEPS', '1')))
     extra["tunnel_h2d_gb_s"] = h2d
     extra["tunnel_d2h_gb_s"] = d2h
     extra["recorded_unix"] = int(time.time())
