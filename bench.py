@@ -274,6 +274,33 @@ def main():
                              config=ds_config, mesh=mesh)
     del params   # engine owns fresh buffers; don't pin 3 GB of fp32 masters
 
+    # DS_BENCH_COMM=1: record the audited gradient-sync plan — which
+    # lowering the engine actually runs (the hlo_audit probe, not the
+    # docstring) and the analytic wire bytes it costs per step. This is
+    # the ladder's provenance for the multi-chip scaling claim.
+    dp_comm = None
+    if os.environ.get("DS_BENCH_COMM") == "1":
+        from deepspeed_tpu.parallel import hlo_audit
+        wire = hlo_audit.grad_sync_wire_model(engine.state.params,
+                                              engine.dp_size)
+        mode = engine._grad_sync_mode
+        declared = hlo_audit.zero2_grad_sync_lowering(engine.mesh, "data") \
+            if engine.dp_size > 1 else "none"
+        # Declarative mode on a regressed backend really pays the
+        # all-reduce wire — record what the compiled program costs, not
+        # what the declaration hoped for.
+        reduce_scattered = (mode == "explicit" or
+                            (mode == "declarative" and
+                             declared == "reduce-scatter"))
+        dp_comm = {
+            "grad_sync_mode": mode,
+            "declared_lowering": declared,
+            "grad_wire_bytes_per_step":
+                wire["reduce_scatter_wire_bytes"] if reduce_scattered
+                else wire["all_reduce_wire_bytes"],
+            "wire_model": wire,
+        }
+
     S = cfg.max_seq_length
     # Device-resident batch = what an async input pipeline provides; a numpy
     # arg would be a synchronous H2D transfer inside every dispatch.
@@ -317,6 +344,8 @@ def main():
         # Ladder provenance: which optimizer apply produced this number.
         "fused_optimizer_apply": ds_config["optimizer"]["params"]["fused"],
     }
+    if dp_comm is not None:
+        record["dp_comm"] = dp_comm
     if jax.devices()[0].platform == "tpu":
         # Free the headline engine's HBM first (a live offload run needs it).
         del engine, batch

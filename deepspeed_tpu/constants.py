@@ -164,6 +164,15 @@ ZERO_REDUCE_BUCKET_SIZE = "reduce_bucket_size"
 ZERO_REDUCE_BUCKET_SIZE_DEFAULT = 500_000_000
 ZERO_REDUCE_SCATTER = "reduce_scatter"
 ZERO_REDUCE_SCATTER_DEFAULT = True
+# How the stage-2 reduce-scatter is obtained when reduce_scatter is on:
+# "declarative" trusts the GSPMD partitioner to lower the declared grad
+# sharding; "explicit" computes grads under shard_map with lax.psum_scatter
+# (guaranteed lowering); "auto" probes the compiled lowering once per
+# backend (parallel/hlo_audit.py) and goes explicit iff the declarative
+# path regresses to a full all-reduce + slice.
+ZERO_GRAD_SYNC = "grad_sync"
+ZERO_GRAD_SYNC_DEFAULT = "auto"
+ZERO_GRAD_SYNC_MODES = ("auto", "declarative", "explicit")
 ZERO_OVERLAP_COMM = "overlap_comm"
 ZERO_OVERLAP_COMM_DEFAULT = False
 ZERO_ALLGATHER_PARTITIONS = "allgather_partitions"

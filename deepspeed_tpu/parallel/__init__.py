@@ -2,3 +2,4 @@ from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology, PipelineParallelGrid,
                        build_mesh, DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
 from . import comm
+from . import hlo_audit

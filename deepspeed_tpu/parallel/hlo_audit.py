@@ -1,0 +1,440 @@
+"""Communication audit of compiled XLA programs.
+
+The repo's ZeRO communication schedule — reduce-scatter(grads) → sharded
+update → all-gather(params), the wire-volume win of ZeRO (Rajbhandari et
+al., 2020) — is *declared* through GSPMD shardings (zero/partition.py) and
+trusted to the SPMD partitioner (Xu et al., GSPMD 2021). Nothing about a
+declaration guarantees the lowering: the known failure mode of declarative
+ZeRO is the partitioner falling back to a full all-reduce + slice, which
+materializes every gradient unpartitioned and doubles the wire bytes.
+
+This module turns the schedule from prose into a checkable artifact:
+
+- ``parse_hlo_collectives`` walks a compiled program's HLO text and
+  extracts every collective (all-reduce, reduce-scatter, all-gather,
+  collective-permute, all-to-all) with its shapes, byte volume, replica
+  groups and enclosing computation (collectives inside a ``while`` body —
+  a ``lax.scan`` — appear once; the caller multiplies by the analytic trip
+  count, which the schedule oracle provides).
+- ``CommAudit`` summarizes the ops and prices each with the standard ring
+  wire model (all-reduce = 2(g-1)/g·B, reduce-scatter/all-gather =
+  (g-1)/g·B, permute = B), the same model the analytic per-config
+  expectations in tools/comm_audit.py use — so compiled reality and the
+  paper's arithmetic are compared in the same currency.
+- ``zero2_grad_sync_lowering`` is a cached capability probe (the
+  tests/capability.py idiom): compile a minimal declared-reduce-scatter
+  program once per (backend, mesh axis) and report whether THIS
+  partitioner honors the declaration. The engine consults it to pick the
+  guaranteed explicit ``lax.psum_scatter`` gradient path when the
+  declarative one regresses.
+
+Everything here is static analysis of ``jit(...).lower(...).compile()``
+output — no step is executed, so auditing a multi-GB config costs only a
+compile.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "CollectiveOp", "CommAudit", "parse_hlo_collectives", "audit_text",
+    "audit_jit", "ring_wire_bytes", "zero2_grad_sync_lowering",
+    "grad_sync_wire_model",
+]
+
+# Bytes per element for the HLO primitive types that can appear in
+# collective shapes. (f8 variants share one entry per byte width.)
+DTYPE_BYTES: Dict[str, int] = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
+    "c128": 16,
+}
+
+COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
+                    "collective-permute", "all-to-all")
+
+# `%name = <shape> <opcode>(<operands>), attr=..., ...` — async collectives
+# appear as `<opcode>-start`; the matching `-done` carries no new traffic.
+_INSTR_RE = re.compile(
+    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
+    r"(?P<shape>\([^=]*?\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s+"
+    r"(?P<op>[a-z\-]+(?:-start)?)\(")
+_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
+_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
+_BODY_RE = re.compile(r"body=%([\w.\-]+)")
+_CALLEE_RE = re.compile(
+    r"(?:calls|to_apply|condition|body|branch_computations)="
+    r"(?:\{)?%([\w.\-]+(?:,\s*%[\w.\-]+)*)")
+_IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
+_LIST_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
+_OPNAME_RE = re.compile(r'op_name="([^"]*)"')
+
+
+def _parse_shapes(shape_str: str, largest_only: bool = False
+                  ) -> Tuple[int, List[str]]:
+    """Total bytes + the individual `dtype[dims]` strings of a (possibly
+    tuple) HLO shape. Layout annotations (`{1,0}`) are ignored.
+
+    ``largest_only``: return the LARGEST component's bytes instead of the
+    sum — for async ``-start`` results, whose tuple aliases the input
+    buffer alongside the output (plus u32 context scalars), summing would
+    double-count the payload. Variadic (non-async) tuple collectives sum.
+    """
+    shapes, total, largest = [], 0, 0
+    for dtype, dims in _SHAPE_RE.findall(shape_str):
+        if dtype not in DTYPE_BYTES:
+            continue    # token types (after-all etc.) carry no payload
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        nbytes = n * DTYPE_BYTES[dtype]
+        total += nbytes
+        largest = max(largest, nbytes)
+        shapes.append(f"{dtype}[{dims}]")
+    return (largest if largest_only else total), shapes
+
+
+def ring_wire_bytes(kind: str, payload_bytes: int, group_size: int) -> int:
+    """Per-participant wire bytes of one collective under the standard ring
+    model — the currency the ZeRO paper's 2x claim is stated in:
+
+    - all-reduce: 2(g-1)/g · B  (reduce-scatter phase + all-gather phase)
+    - reduce-scatter / all-gather / all-to-all: (g-1)/g · B over the FULL
+      (unscattered) buffer B
+    - collective-permute: B (each source ships its buffer once)
+    """
+    g = max(1, group_size)
+    if kind == "all-reduce":
+        return 2 * (g - 1) * payload_bytes // g
+    if kind in ("reduce-scatter", "all-gather", "all-to-all"):
+        return (g - 1) * payload_bytes // g
+    if kind == "collective-permute":
+        return payload_bytes
+    raise ValueError(f"unknown collective kind {kind!r}")
+
+
+@dataclasses.dataclass
+class CollectiveOp:
+    kind: str                 # normalized (no -start suffix)
+    name: str                 # HLO instruction name
+    computation: str          # enclosing HLO computation ("" if unknown)
+    out_bytes: int
+    in_bytes: int
+    out_shapes: List[str]
+    in_shapes: List[str]
+    group_size: int           # participants per replica group
+    num_groups: int
+    source_target_pairs: Optional[List[Tuple[int, int]]]
+    op_name: str              # jax op metadata (attribution)
+    in_loop: bool = False     # inside a while (lax.scan) body: executes
+                              # once per trip, not once per step
+
+    @property
+    def payload_bytes(self) -> int:
+        """The full (unscattered) buffer the wire model prices: the input
+        for reduce-scatter (its output is the 1/g shard), the output for
+        all-gather (its input is the shard), the buffer itself otherwise."""
+        if self.kind == "reduce-scatter":
+            return self.in_bytes
+        return self.out_bytes
+
+    @property
+    def wire_bytes(self) -> int:
+        if self.kind == "collective-permute":
+            # A device only transmits if it appears as a source; shaped as
+            # per-participating-device bytes.
+            return self.out_bytes
+        return ring_wire_bytes(self.kind, self.payload_bytes, self.group_size)
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["wire_bytes"] = self.wire_bytes
+        d["payload_bytes"] = self.payload_bytes
+        return d
+
+
+def _loop_computations(comp_lines: Dict[str, List[str]]) -> set:
+    """Computation names reachable from any ``while`` body — collectives
+    there run once per trip count. Follows calls/branches transitively so
+    a collective inside a ``lax.cond`` inside a scan is still loop-tagged."""
+    callees: Dict[str, set] = {}
+    roots: set = set()
+    for name, lines in comp_lines.items():
+        refs: set = set()
+        for line in lines:
+            for mm in _CALLEE_RE.finditer(line):
+                for ref in mm.group(1).split(","):
+                    refs.add(ref.strip().lstrip("%"))
+            bm = _BODY_RE.search(line)
+            if bm and " while(" in line:
+                roots.add(bm.group(1))
+        callees[name] = refs
+    reach, frontier = set(), set(roots)
+    while frontier:
+        c = frontier.pop()
+        if c in reach:
+            continue
+        reach.add(c)
+        frontier |= callees.get(c, set())
+    return reach
+
+
+def parse_hlo_collectives(hlo_text: str) -> List[CollectiveOp]:
+    """Extract every collective instruction from optimized-HLO text.
+
+    Handles both replica-group encodings XLA prints (`{{0,1,...}}` lists
+    and the iota form `[G,g]<=[N]`), tuple-shaped variadic collectives,
+    and async `-start`/`-done` pairs (only `-start` is counted). Each op
+    records its enclosing computation and whether that computation is
+    (transitively) a while-loop body."""
+    comp_lines: Dict[str, List[str]] = {}
+    computation = ""
+    for line in hlo_text.splitlines():
+        comp = _COMP_RE.match(line)
+        # Header lines are `%name (params) -> result {`; instruction lines
+        # always contain an ` = ` assignment (a bare `=` check would
+        # misfire on the `/*index=N*/` markers in long tuple params).
+        if comp and " = " not in line:
+            computation = comp.group(1)
+            comp_lines.setdefault(computation, [])
+            continue
+        comp_lines.setdefault(computation, []).append(line)
+    loop_comps = _loop_computations(comp_lines)
+
+    ops: List[CollectiveOp] = []
+    for computation, lines in comp_lines.items():
+        for line in lines:
+            m = _INSTR_RE.match(line)
+            if not m:
+                continue
+            op = m.group("op")
+            is_async = op.endswith("-start")
+            kind = op[:-6] if is_async else op
+            if kind not in COLLECTIVE_KINDS:
+                continue
+            out_bytes, out_shapes = _parse_shapes(m.group("shape"),
+                                                  largest_only=is_async)
+            # Operands: everything inside the call parens up to the
+            # matching close — `dtype[dims]{layout} %operand` pairs.
+            rest = line[m.end():]
+            depth, i = 1, 0
+            while i < len(rest) and depth:
+                if rest[i] == "(":
+                    depth += 1
+                elif rest[i] == ")":
+                    depth -= 1
+                i += 1
+            in_bytes, in_shapes = _parse_shapes(rest[:i - 1])
+            attrs = rest[i:]
+
+            group_size, num_groups = 1, 1
+            gm = _IOTA_GROUPS_RE.search(attrs)
+            if gm:
+                num_groups, group_size = int(gm.group(1)), int(gm.group(2))
+            else:
+                gm = _LIST_GROUPS_RE.search(attrs)
+                if gm:
+                    groups = [g for g in gm.group(1)[1:-1].split("},{")]
+                    num_groups = len(groups)
+                    group_size = max(
+                        len([r for r in g.split(",") if r != ""])
+                        for g in groups)
+            pairs = None
+            pm = _PAIRS_RE.search(attrs)
+            if pm:
+                pairs = [tuple(int(x) for x in p.split(","))
+                        for p in pm.group(1)[1:-1].split("},{")]
+                group_size = max(group_size, len(pairs))
+            om = _OPNAME_RE.search(attrs)
+            ops.append(CollectiveOp(
+                kind=kind, name=m.group("name"), computation=computation,
+                out_bytes=out_bytes, in_bytes=in_bytes,
+                out_shapes=out_shapes, in_shapes=in_shapes,
+                group_size=group_size, num_groups=num_groups,
+                source_target_pairs=pairs,
+                op_name=om.group(1) if om else "",
+                in_loop=computation in loop_comps))
+    return ops
+
+
+_CONST_RE = re.compile(r"constant\((\d+)\)")
+_COND_RE = re.compile(r"condition=%([\w.\-]+)")
+
+
+def while_trip_counts(hlo_text: str) -> List[int]:
+    """Best-effort static trip counts: the integer constants appearing in
+    each ``while`` instruction's CONDITION computation (a ``lax.scan``'s
+    bound compiles to ``compare(i, constant(T)), direction=LT``). Returns
+    every candidate, largest first — callers check membership of the
+    analytic count rather than assuming a unique bound."""
+    comp_lines: Dict[str, List[str]] = {}
+    computation = ""
+    conds: List[str] = []
+    for line in hlo_text.splitlines():
+        comp = _COMP_RE.match(line)
+        if comp and " = " not in line:
+            computation = comp.group(1)
+            comp_lines.setdefault(computation, [])
+            continue
+        comp_lines.setdefault(computation, []).append(line)
+        if " while(" in line:
+            cm = _COND_RE.search(line)
+            if cm:
+                conds.append(cm.group(1))
+    counts: List[int] = []
+    for cond in conds:
+        for line in comp_lines.get(cond, []):
+            counts.extend(int(c) for c in _CONST_RE.findall(line))
+    return sorted(set(counts), reverse=True)
+
+
+@dataclasses.dataclass
+class CommAudit:
+    """Structured report over one compiled program's collectives."""
+    ops: List[CollectiveOp]
+    hlo_text: str = ""
+
+    def while_trip_counts(self) -> List[int]:
+        return while_trip_counts(self.hlo_text)
+
+    def of_kind(self, kind: str) -> List[CollectiveOp]:
+        return [o for o in self.ops if o.kind == kind]
+
+    def in_loops(self, kind: Optional[str] = None) -> List[CollectiveOp]:
+        """Collectives inside while-loop computations (scan bodies) — they
+        execute once per trip, so their static bytes must be multiplied by
+        the analytic trip count."""
+        return [o for o in self.ops if o.in_loop
+                and (kind is None or o.kind == kind)]
+
+    def total_wire(self, kind: Optional[str] = None) -> int:
+        return sum(o.wire_bytes for o in self.ops
+                   if kind is None or o.kind == kind)
+
+    def summary(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for o in self.ops:
+            s = out.setdefault(o.kind, {"count": 0, "payload_bytes": 0,
+                                        "wire_bytes": 0})
+            s["count"] += 1
+            s["payload_bytes"] += o.payload_bytes
+            s["wire_bytes"] += o.wire_bytes
+        return out
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"summary": self.summary(),
+                "ops": [o.to_dict() for o in self.ops]}
+
+
+def audit_text(hlo_text: str) -> CommAudit:
+    return CommAudit(parse_hlo_collectives(hlo_text), hlo_text)
+
+
+def audit_jit(fn, *args, **kwargs) -> CommAudit:
+    """Audit a jitted callable on concrete (or ShapeDtypeStruct) args:
+    lower → compile → parse. Compile-only; nothing executes."""
+    import jax
+    if not hasattr(fn, "lower"):
+        fn = jax.jit(fn)
+    compiled = fn.lower(*args, **kwargs).compile()
+    return audit_text(compiled.as_text())
+
+
+# --------------------------------------------------------------------- #
+# The ZeRO-2 lowering probe + analytic wire model
+# --------------------------------------------------------------------- #
+_PROBE_CACHE: Dict[Tuple, str] = {}
+
+
+def zero2_grad_sync_lowering(mesh, axis_name: str = "data",
+                             dtype=None) -> str:
+    """What a DECLARED dp-sharded gradient actually compiles to on this
+    backend: ``"reduce-scatter"`` | ``"all-reduce"`` | ``"none"``.
+
+    Compiles (never runs) a minimal replica of the engine's declarative
+    ZeRO-2 pattern — batch sharded over ``axis_name``, grads constrained to
+    a dp-sharded ``NamedSharding`` — and inspects which collective carries
+    the cross-dp sync. "all-reduce" is the known GSPMD fallback (full
+    all-reduce + slice): the gradient materializes unpartitioned and the
+    wire bytes double vs the ZeRO schedule. Cached per (backend devices,
+    axis, dtype) like tests/capability.py, so callers probe freely."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    dtype = dtype or jnp.float32
+    n = int(mesh.shape[axis_name])
+    if n <= 1:
+        return "none"
+    # The axis SIZE must be in the key: a dp=8 and a dp=4 x mp=2 mesh
+    # enumerate the same device ids under the same axis name but compile
+    # different probe programs.
+    key = (tuple(d.id for d in mesh.devices.flat), axis_name, n,
+           jnp.dtype(dtype).name)
+    if key in _PROBE_CACHE:
+        return _PROBE_CACHE[key]
+
+    d = 2 * n
+    w_sh = NamedSharding(mesh, P(axis_name))
+    x_sh = NamedSharding(mesh, P(axis_name))
+
+    def probe(w, x):
+        g = jax.grad(lambda w_, x_: jnp.mean((x_ @ w_) ** 2))(w, x)
+        return lax.with_sharding_constraint(g, w_sh)
+
+    w = jax.ShapeDtypeStruct((d, d), dtype, sharding=NamedSharding(mesh, P()))
+    x = jax.ShapeDtypeStruct((d, d), dtype, sharding=x_sh)
+    try:
+        audit = audit_jit(probe, w, x)
+    except Exception:   # pragma: no cover - exotic backend
+        _PROBE_CACHE[key] = "none"
+        return "none"
+    result = "none"
+    if audit.of_kind("reduce-scatter"):
+        result = "reduce-scatter"
+    elif audit.of_kind("all-reduce"):
+        result = "all-reduce"
+    _PROBE_CACHE[key] = result
+    return result
+
+
+def grad_sync_wire_model(params: Any, dp: int,
+                         grad_bytes_per_el: int = 4) -> Dict[str, int]:
+    """Analytic per-step gradient-sync wire bytes for a param tree under
+    dp-way data parallelism, in both lowerings. Scatterable leaves follow
+    zero/partition.py's rule (first dim >= dp and divisible); the rest are
+    replicated and all-reduce in either mode (they are the small tail)."""
+    import jax
+    from .topology import DP_AXIS  # noqa: F401  (doc anchor)
+    from ..runtime.zero.partition import _leaf_spec
+
+    scatterable = replicated = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or getattr(leaf, "ndim", 0) < 1:
+            continue
+        nbytes = int(grad_bytes_per_el)
+        for s in shape:
+            nbytes *= int(s)
+        if any(e is not None for e in _leaf_spec(shape, dp, "data")):
+            scatterable += nbytes
+        else:
+            replicated += nbytes
+    repl_wire = ring_wire_bytes("all-reduce", replicated, dp)
+    return {
+        "dp": dp,
+        "grad_bytes": scatterable + replicated,
+        "scatterable_bytes": scatterable,
+        "replicated_bytes": replicated,
+        "reduce_scatter_wire_bytes":
+            ring_wire_bytes("reduce-scatter", scatterable, dp) + repl_wire,
+        "all_reduce_wire_bytes":
+            ring_wire_bytes("all-reduce", scatterable, dp) + repl_wire,
+    }

@@ -7,9 +7,16 @@ cpu_offload, elastic_checkpoint (zero/config.py:61-107); legacy bool→dict
 migration (zero/config.py:36-53).
 
 TPU mapping notes: bucket sizes become scan-chunk hints for the sharded
-update; for the device collectives ``overlap_comm`` is advisory (XLA's
-latency-hiding scheduler overlaps reduce-scatter with backward
-automatically); ``cpu_offload`` moves optimizer state to TPU-VM host RAM,
+update; ``reduce_scatter: false`` selects the dense all-reduce gradient
+path (stage-2 grads stay replicated, reference semantics), and
+``grad_sync`` picks how the reduce-scatter is obtained when it is on —
+"declarative" (GSPMD sharding declaration), "explicit" (guaranteed
+``lax.psum_scatter`` under shard_map), or "auto" (probe the compiled
+lowering, go explicit iff the declaration regresses to all-reduce+slice;
+see parallel/hlo_audit.py). For the device collectives ``overlap_comm``
+is advisory (XLA's latency-hiding scheduler overlaps reduce-scatter with
+backward automatically — the engine says so at init instead of silently
+swallowing the knob); ``cpu_offload`` moves optimizer state to TPU-VM host RAM,
 and there ``overlap_comm`` is load-bearing: it selects the bucketed
 overlapped offload pipeline (D2H / host Adam / H2D streamed per
 ``offload_bucket_size`` bucket through an ``offload_host_threads`` worker
@@ -28,6 +35,7 @@ class ZeroConfig:
         self.stage = C.ZERO_STAGE_DEFAULT
         self.contiguous_gradients = C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT
         self.reduce_scatter = C.ZERO_REDUCE_SCATTER_DEFAULT
+        self.grad_sync = C.ZERO_GRAD_SYNC_DEFAULT
         self.reduce_bucket_size = C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT
         self.allgather_partitions = C.ZERO_ALLGATHER_PARTITIONS_DEFAULT
         self.allgather_bucket_size = C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT
@@ -56,6 +64,16 @@ class ZeroConfig:
         self.reduce_bucket_size = get(d, C.ZERO_REDUCE_BUCKET_SIZE,
                                       C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT)
         self.reduce_scatter = get(d, C.ZERO_REDUCE_SCATTER, C.ZERO_REDUCE_SCATTER_DEFAULT)
+        self.grad_sync = get(d, C.ZERO_GRAD_SYNC, C.ZERO_GRAD_SYNC_DEFAULT)
+        if self.grad_sync not in C.ZERO_GRAD_SYNC_MODES:
+            raise ValueError(
+                f"{C.ZERO_GRAD_SYNC} must be one of "
+                f"{C.ZERO_GRAD_SYNC_MODES}, got {self.grad_sync!r}")
+        if not self.reduce_scatter and self.grad_sync == "explicit":
+            raise ValueError(
+                f"{C.ZERO_GRAD_SYNC}='explicit' requires "
+                f"{C.ZERO_REDUCE_SCATTER}: true — reduce_scatter: false "
+                "selects the dense all-reduce gradient path")
         self.overlap_comm = get(d, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT)
         self.allgather_partitions = get(d, C.ZERO_ALLGATHER_PARTITIONS,
                                         C.ZERO_ALLGATHER_PARTITIONS_DEFAULT)

@@ -185,6 +185,39 @@ class TestZeroConfig:
         with pytest.raises(ValueError):
             make_cfg({"train_batch_size": 8, "zero_optimization": {"stage": 9}})
 
+    def test_grad_sync_knob(self):
+        from deepspeed_tpu import constants as C
+        dflt = make_cfg({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 2}})
+        assert dflt.zero_config.grad_sync == C.ZERO_GRAD_SYNC_DEFAULT == "auto"
+        assert dflt.zero_config.reduce_scatter   # default on
+        for mode in C.ZERO_GRAD_SYNC_MODES:
+            cfg = make_cfg({"train_batch_size": 8,
+                            "zero_optimization": {"stage": 2,
+                                                  "grad_sync": mode}})
+            assert cfg.zero_config.grad_sync == mode
+
+    def test_grad_sync_invalid_value_raises(self):
+        with pytest.raises(ValueError):
+            make_cfg({"train_batch_size": 8,
+                      "zero_optimization": {"stage": 2,
+                                            "grad_sync": "hopeful"}})
+
+    def test_reduce_scatter_false_conflicts_with_explicit(self):
+        """reduce_scatter: false selects the dense all-reduce path — an
+        explicit psum_scatter request alongside it is a contradiction,
+        rejected at config parse."""
+        with pytest.raises(ValueError):
+            make_cfg({"train_batch_size": 8,
+                      "zero_optimization": {"stage": 2,
+                                            "reduce_scatter": False,
+                                            "grad_sync": "explicit"}})
+        # but the dense path itself parses fine
+        cfg = make_cfg({"train_batch_size": 8,
+                        "zero_optimization": {"stage": 2,
+                                              "reduce_scatter": False}})
+        assert not cfg.zero_config.reduce_scatter
+
 
 class TestOptimizerScheduler:
     def test_optimizer_params(self):

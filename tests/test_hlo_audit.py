@@ -1,0 +1,429 @@
+"""HLO communication audit: parser units + the wire-model regression tests
+that turn the repo's scaling claims into machine-checked invariants.
+
+The load-bearing assertions (ISSUE 3 acceptance):
+- ZeRO-2 gradient sync compiles to reduce-scatter with wire bytes ON the
+  analytic model — and grads never materialize unpartitioned (no
+  grad-sized all-reduce). The engine's grad_sync=auto guarantees this via
+  the explicit lax.psum_scatter path when the declarative GSPMD lowering
+  regresses to all-reduce + slice (this backend does regress: the probe
+  is part of the test).
+- The explicit path is BIT-identical (params and moments) to the
+  declarative path on the dp=8 mesh.
+- 1-bit Adam's compression-phase wire format is ~1/32 of dense.
+- 1F1B boundary traffic = 2 directions x boundary x ticks.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.parallel import hlo_audit
+from deepspeed_tpu.parallel.topology import build_mesh
+
+from simple_model import (simple_model_params, simple_loss_fn, random_batch,
+                          base_config)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------------ #
+# Parser units (synthetic HLO text)
+# ------------------------------------------------------------------ #
+SYNTH = """
+HloModule jit_step
+
+%add.clone (x: f32[], y: f32[]) -> f32[] {
+  ROOT %add.2 = f32[] add(f32[] %x, f32[] %y)
+}
+
+%body.1 (p: (s32[], f32[4,16])) -> (s32[], f32[4,16]) {
+  %cp = f32[4,16]{1,0} collective-permute(f32[4,16]{1,0} %gte.1), channel_id=3, source_target_pairs={{0,1},{1,2},{2,3}}, metadata={op_name="scan/permute"}
+  ROOT %t = (s32[], f32[4,16]{1,0}) tuple(s32[] %i, f32[4,16]{1,0} %cp)
+}
+
+%cond.1 (p: (s32[], f32[4,16])) -> pred[] {
+  ROOT %lt = pred[] compare(s32[] %a, s32[] %b), direction=LT
+}
+
+ENTRY %main.1 (arg: f32[8,16]) -> f32[2,4] {
+  %ar = f32[4,16]{1,0} all-reduce(f32[4,16]{1,0} %dot.1), channel_id=1, replica_groups=[1,8]<=[8], use_global_device_ids=true, to_apply=%add.clone, metadata={op_name="jit(step)/psum"}
+  %rs = f32[2,16]{1,0} reduce-scatter(f32[16,16]{1,0} %b.3), channel_id=2, replica_groups={{0,1,2,3,4,5,6,7}}, use_global_device_ids=true, dimensions={0}, to_apply=%add.clone
+  %w = (s32[], f32[4,16]{1,0}) while((s32[], f32[4,16]{1,0}) %tp), condition=%cond.1, body=%body.1
+  %ag = (f32[2]{0}, f32[4]{0}) all-gather(f32[1]{0} %s1, f32[2]{0} %s2), channel_id=4, replica_groups={{0,1}}, dimensions={0}
+  ROOT %out = f32[2,4]{1,0} bitcast(f32[2,16]{1,0} %rs)
+}
+"""
+
+
+class TestParser:
+    def test_kinds_and_bytes(self):
+        ops = hlo_audit.parse_hlo_collectives(SYNTH)
+        by = {o.kind: o for o in ops}
+        assert set(by) == {"all-reduce", "reduce-scatter",
+                           "collective-permute", "all-gather"}
+        ar = by["all-reduce"]
+        assert ar.out_bytes == 4 * 16 * 4 and ar.group_size == 8
+        assert ar.num_groups == 1 and ar.op_name == "jit(step)/psum"
+        rs = by["reduce-scatter"]
+        assert rs.in_bytes == 16 * 16 * 4 and rs.out_bytes == 2 * 16 * 4
+        assert rs.payload_bytes == rs.in_bytes      # wire prices the input
+        ag = by["all-gather"]                       # tuple-shaped variadic
+        assert ag.out_bytes == (2 + 4) * 4 and ag.group_size == 2
+
+    def test_wire_model(self):
+        ops = {o.kind: o for o in hlo_audit.parse_hlo_collectives(SYNTH)}
+        # ring all-reduce: 2(g-1)/g * B
+        assert ops["all-reduce"].wire_bytes == 2 * 7 * 256 // 8
+        # ring reduce-scatter: (g-1)/g * full input
+        assert ops["reduce-scatter"].wire_bytes == 7 * 1024 // 8
+        assert ops["collective-permute"].wire_bytes == 4 * 16 * 4
+
+    def test_loop_attribution(self):
+        ops = hlo_audit.parse_hlo_collectives(SYNTH)
+        cp = next(o for o in ops if o.kind == "collective-permute")
+        assert cp.in_loop and cp.computation == "body.1"
+        assert cp.source_target_pairs == [(0, 1), (1, 2), (2, 3)]
+        ar = next(o for o in ops if o.kind == "all-reduce")
+        assert not ar.in_loop
+
+    def test_summary(self):
+        audit = hlo_audit.audit_text(SYNTH)
+        s = audit.summary()
+        assert s["all-reduce"]["count"] == 1
+        assert audit.total_wire("reduce-scatter") == 7 * 1024 // 8
+
+    def test_async_start_does_not_double_count(self):
+        """A `-start` result tuple aliases the input buffer next to the
+        output (plus u32 context scalars) — payload must be the largest
+        component, not the tuple sum (TPU emits async collectives by
+        default)."""
+        text = """
+ENTRY %main (p: f32[4,16]) -> f32[4,16] {
+  %cps = (f32[4,16]{1,0}, f32[4,16]{1,0}, u32[], u32[]) collective-permute-start(f32[4,16]{1,0} %p), channel_id=1, source_target_pairs={{0,1},{1,0}}
+  %ags = (f32[1,16]{1,0}, f32[8,16]{1,0}) all-gather-start(f32[1,16]{1,0} %p2), channel_id=2, replica_groups=[1,8]<=[8], dimensions={0}
+  ROOT %cpd = f32[4,16]{1,0} collective-permute-done((f32[4,16]{1,0}, f32[4,16]{1,0}, u32[], u32[]) %cps)
+}
+"""
+        ops = hlo_audit.parse_hlo_collectives(text)
+        by = {o.kind: o for o in ops}
+        assert len(ops) == 2            # -done carries no new traffic
+        assert by["collective-permute"].out_bytes == 4 * 16 * 4
+        # all-gather-start: output is the larger (gathered) component
+        assert by["all-gather"].out_bytes == 8 * 16 * 4
+        assert by["all-gather"].wire_bytes == 7 * (8 * 16 * 4) // 8
+
+    def test_while_trip_counts(self):
+        counts = hlo_audit.while_trip_counts(SYNTH)
+        assert counts == []             # SYNTH's cond has no constants
+        text = SYNTH.replace(
+            "ROOT %lt = pred[] compare(s32[] %a, s32[] %b), direction=LT",
+            "%c9 = s32[] constant(9)\n"
+            "  ROOT %lt = pred[] compare(s32[] %a, s32[] %c9), direction=LT")
+        assert 9 in hlo_audit.while_trip_counts(text)
+
+
+class TestProbe:
+    def test_lowering_probe_known_value(self, mesh8):
+        """This backend's partitioner lowers the declared ZeRO-2 grad
+        sharding to all-reduce + slice — the exact regression the
+        explicit path exists for. (On a backend that honors the
+        declaration this returns 'reduce-scatter' and auto mode keeps
+        the declarative path — both are valid outcomes; 'none' is not.)"""
+        got = hlo_audit.zero2_grad_sync_lowering(mesh8, "data")
+        assert got in ("reduce-scatter", "all-reduce")
+        # cached: second call must not recompile (same object back)
+        assert hlo_audit.zero2_grad_sync_lowering(mesh8, "data") == got
+
+
+# ------------------------------------------------------------------ #
+# ZeRO-2: the guaranteed reduce-scatter gradient path
+# ------------------------------------------------------------------ #
+def _engine(gas=1, seed=0, **zero_overrides):
+    zero = {"stage": 2}
+    zero.update(zero_overrides)
+    params = simple_model_params(jax.random.PRNGKey(seed))
+    cfg = base_config(
+        zero_optimization=zero, gradient_accumulation_steps=gas,
+        train_batch_size=16 * gas,
+        # fused=False keeps the optimizer apply's own collectives (the
+        # chunked front-end gather, see COMM_AUDIT.json findings) out of
+        # the grad-sync assertions.
+        optimizer={"type": "Adam", "params": {"lr": 1e-2, "fused": False}})
+    engine, *_ = deepspeed_tpu.initialize(
+        model=simple_loss_fn, model_params=params, config=cfg)
+    return engine
+
+
+def _audit_step(engine, gas=1):
+    batch = random_batch(n=16 * gas)
+    mb = engine._stack_micro_batches(batch)
+    mb = jax.device_put(mb, engine._batch_sharding(mb, leading_dims=2))
+    fn = engine._build_train_step()
+    return hlo_audit.audit_jit(fn, engine.state, mb, engine._base_rng)
+
+
+class TestZero2ReduceScatterRegression:
+    """Tier-1 gate: fails if ZeRO-2 gradient sync compiles to a full
+    all-reduce (wire bytes off the analytic reduce-scatter model)."""
+
+    def test_grad_sync_is_reduce_scattered(self):
+        e = _engine()
+        audit = _audit_step(e)
+        model = hlo_audit.grad_sync_wire_model(
+            jax.device_get(e.state.params), e.dp_size)
+        rs = audit.of_kind("reduce-scatter")
+        # Every scatterable grad leaf is reduce-scattered: the summed
+        # reduce-scatter payload equals the model's scatterable bytes
+        # exactly (w1 [8,16] + b1 [16] + w2 [16,4] in f32).
+        assert sum(o.payload_bytes for o in rs) == \
+            model["scatterable_bytes"], audit.summary()
+        # Wire bytes on the analytic reduce-scatter model.
+        repl_wire = hlo_audit.ring_wire_bytes(
+            "all-reduce", model["replicated_bytes"], e.dp_size)
+        assert sum(o.wire_bytes for o in rs) + repl_wire == \
+            model["reduce_scatter_wire_bytes"]
+        # ~half the all-reduce wire (the ZeRO-2 claim).
+        assert model["reduce_scatter_wire_bytes"] <= \
+            0.52 * model["all_reduce_wire_bytes"]
+
+    def test_grads_never_materialize_unpartitioned(self):
+        """No all-reduce in the step carries a scatterable-grad-sized
+        payload: the fallback lowering (full all-reduce + slice) is the
+        failure this test exists to catch."""
+        from deepspeed_tpu.runtime.zero.partition import _leaf_spec
+        e = _engine()
+        audit = _audit_step(e)
+        scatterable_leaf_bytes = {
+            int(np.prod(l.shape)) * 4
+            for l in jax.tree_util.tree_leaves(
+                jax.device_get(e.state.params))
+            if any(s is not None
+                   for s in _leaf_spec(l.shape, e.dp_size, "data"))}
+        for o in audit.of_kind("all-reduce"):
+            assert o.payload_bytes not in scatterable_leaf_bytes, \
+                (o.out_shapes, o.op_name)
+
+    def test_gas2_scatters_inside_the_scan(self):
+        """Per-micro-step scatter: the accumulation carry holds 1/dp
+        shards only, and the reduce-scatter lives in the scan body."""
+        e = _engine(gas=2)
+        audit = _audit_step(e, gas=2)
+        rs = audit.of_kind("reduce-scatter")
+        assert rs and all(o.in_loop for o in rs), \
+            [(o.computation, o.in_loop) for o in rs]
+
+    def test_auto_mode_matches_probe(self, mesh8):
+        e = _engine()
+        lowering = hlo_audit.zero2_grad_sync_lowering(mesh8, "data")
+        want = "declarative" if lowering == "reduce-scatter" else "explicit"
+        assert e._grad_sync_mode == want
+
+
+class TestExplicitDeclarativeParity:
+    """Explicit psum_scatter vs declarative GSPMD parity on the dp=8 mesh.
+
+    ONE step from identical state is bit-identical (params, moments, and
+    loss — asserted below): the local per-rank computation is the same
+    program modulo exact power-of-two loss-mean scaling. Across a
+    multi-step trajectory the two lowerings' cross-dp reductions sum
+    partials in different orders (ring reduce-scatter rotates each
+    shard's start rank; all-reduce+slice does not), so strict bitwise
+    equality across programs is impossible on generic values — the same
+    cross-program limit PR 1 documented for FMA contraction in the fused
+    optimizer (tests/test_fused_update.py). The drift is ulp-level in the
+    GRADS; Adam's normalized update turns that into an absolute
+    (lr-scaled) wiggle on params, so the trajectory bound below is
+    absolute: observed <= 7.5e-9 after 3 steps at lr=1e-2, asserted at
+    1e-7."""
+
+    def test_single_step_bit_identical(self):
+        engines = {m: _engine(seed=7, grad_sync=m)
+                   for m in ("declarative", "explicit")}
+        batch = random_batch(n=16, seed=11)
+        losses = {m: e.train_batch(batch=batch)
+                  for m, e in engines.items()}
+        assert float(losses["declarative"]) == float(losses["explicit"])
+        for field in ("params", "opt_state"):
+            a = jax.tree_util.tree_leaves(
+                jax.device_get(getattr(engines["declarative"].state, field)))
+            b = jax.tree_util.tree_leaves(
+                jax.device_get(getattr(engines["explicit"].state, field)))
+            for x, y in zip(a, b):
+                np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+    @pytest.mark.parametrize("gas", [1, 2])
+    def test_trajectory_ulp_bounded(self, gas):
+        engines = {m: _engine(gas=gas, seed=7, grad_sync=m)
+                   for m in ("declarative", "explicit")}
+        assert engines["explicit"]._grad_sync_mode == "explicit"
+        assert engines["declarative"]._grad_sync_mode == "declarative"
+        batch = random_batch(n=16 * gas, seed=11)
+        for _ in range(3):
+            losses = {m: e.train_batch(batch=batch)
+                      for m, e in engines.items()}
+        assert float(losses["declarative"]) == float(losses["explicit"])
+        for field in ("params", "opt_state"):
+            a = jax.tree_util.tree_leaves(
+                jax.device_get(getattr(engines["declarative"].state, field)))
+            b = jax.tree_util.tree_leaves(
+                jax.device_get(getattr(engines["explicit"].state, field)))
+            for x, y in zip(a, b):
+                x, y = np.asarray(x, np.float64), np.asarray(y, np.float64)
+                np.testing.assert_allclose(x, y, rtol=0, atol=1e-7,
+                                           err_msg=field)
+
+    def test_explicit_grads_stay_dp_sharded(self):
+        e = _engine(grad_sync="explicit")
+        fn = e._build_train_step()
+        batch = random_batch(n=16)
+        mb = e._stack_micro_batches(batch)
+        mb = jax.device_put(mb, e._batch_sharding(mb, leading_dims=2))
+        txt = fn.lower(e.state, mb, e._base_rng).compile().as_text()
+        assert "reduce-scatter" in txt
+
+
+class TestHonestKnobs:
+    def test_reduce_scatter_false_selects_dense_allreduce(self):
+        e = _engine(reduce_scatter=False)
+        assert e._grad_sync_mode == "allreduce"
+        assert e._grad_shardings() is None
+        audit = _audit_step(e)
+        assert not audit.of_kind("reduce-scatter"), audit.summary()
+        assert audit.of_kind("all-reduce")
+
+    def test_reduce_scatter_false_trains_to_parity(self):
+        batch = random_batch(n=16, seed=5)
+        e_rs = _engine(seed=3)
+        e_ar = _engine(seed=3, reduce_scatter=False)
+        for _ in range(3):
+            l_rs = e_rs.train_batch(batch=batch)
+            l_ar = e_ar.train_batch(batch=batch)
+        np.testing.assert_allclose(float(l_rs), float(l_ar), rtol=1e-5)
+
+    @staticmethod
+    def _capture_logs(fn):
+        # The repo logger sets propagate=False, so pytest's caplog (root
+        # handler) never sees it — attach a handler directly.
+        import logging
+        records = []
+
+        class H(logging.Handler):
+            def emit(self, r):
+                records.append(r.getMessage())
+
+        lg = logging.getLogger("deepspeed_tpu")
+        h = H()
+        lg.addHandler(h)
+        try:
+            fn()
+        finally:
+            lg.removeHandler(h)
+        return records
+
+    def test_overlap_comm_notice_logged(self):
+        msgs = self._capture_logs(lambda: _engine(overlap_comm=True))
+        assert any("latency-hiding scheduler" in m for m in msgs), msgs
+
+    def test_init_logs_audited_lowering_and_wire_bytes(self):
+        msgs = [m for m in self._capture_logs(lambda: _engine())
+                if "ZeRO-2 grad sync" in m]
+        assert msgs and "wire bytes/step" in msgs[0], msgs
+
+    def test_explicit_requires_pure_dp(self):
+        """grad_sync='explicit' on an ineligible config is a loud error,
+        not a silent declarative fallback."""
+        params = simple_model_params(jax.random.PRNGKey(0))
+        cfg = base_config(
+            zero_optimization={"stage": 2, "grad_sync": "explicit"},
+            mesh={"model_parallel_size": 2})   # dp=4 x mp=2: not pure dp
+        with pytest.raises(ValueError, match="explicit"):
+            deepspeed_tpu.initialize(model=simple_loss_fn,
+                                     model_params=params, config=cfg)
+
+
+# ------------------------------------------------------------------ #
+# 1-bit Adam wire model
+# ------------------------------------------------------------------ #
+class TestOnebitWire:
+    def test_compression_phase_is_about_one_32th_dense(self):
+        """Tier-1 gate: fails if 1-bit exceeds ~1/32 dense wire (sign bit
+        per element + one f32 scale per chunk, dp=8 chunks)."""
+        from deepspeed_tpu.ops.onebit import comm_bytes, compression_ratio
+        n = 1 << 20
+        dense = comm_bytes(n, compressed=False)
+        compressed = comm_bytes(n, compressed=True, chunks=8)
+        assert compressed <= dense / 28, (compressed, dense)
+        assert compression_ratio(n, chunks=8) >= 28
+        # asymptotically exactly 32x minus the scale overhead
+        assert abs(compression_ratio(1 << 26, chunks=8) - 32.0) < 0.1
+
+    def test_comm_audit_record_consistent(self):
+        """The recorded COMM_AUDIT.json (tools/run_comm_audit.sh) must
+        exist and pass its own checks — the artifact form of these
+        invariants."""
+        path = os.path.join(REPO, "COMM_AUDIT.json")
+        assert os.path.exists(path), "run tools/run_comm_audit.sh"
+        rec = json.load(open(path))
+        assert rec["all_pass"] is True
+        for name in ("zero1", "zero2", "onebit", "pipeline_1f1b",
+                     "ring_attention"):
+            assert rec["configs"][name]["pass"] is True, name
+
+
+# ------------------------------------------------------------------ #
+# 1F1B boundary-permute bytes
+# ------------------------------------------------------------------ #
+class Test1F1BPermuteBytes:
+    def test_permute_bytes_equal_boundary_times_ticks(self):
+        """Tier-1 gate: fails if 1F1B permute bytes != boundary x ticks.
+        The scan body must hold exactly two boundary-sized
+        collective-permutes (activations up, cotangents down); per-step
+        traffic is 2 x boundary x (M + 2(P-1)) with ticks from the
+        schedule oracle."""
+        from deepspeed_tpu.runtime.pipe.spmd_1f1b import (
+            spmd_pipeline_1f1b_grads, tick_table)
+        Pstages, M, mb, H, S, V = 4, 3, 2, 16, 4, 32
+        mesh = build_mesh(pp=Pstages, dp=1,
+                          devices=jax.devices()[:Pstages])
+        k = jax.random.PRNGKey(0)
+        params = {"shared": {"wte": jax.random.normal(k, (V, H)) * 0.1},
+                  "blocks": {"w": jax.random.normal(k, (Pstages, H, H))}}
+
+        def embed_fn(shared, tokens, rng):
+            return shared["wte"][tokens]
+
+        def stage_fn(blocks, x, rng):
+            return jnp.tanh(x @ blocks["w"][0])
+
+        def head_fn(shared, y, targets, rng):
+            logits = y @ shared["wte"].T
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+            onehot = jax.nn.one_hot(targets, logits.shape[-1])
+            return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+        gfn = spmd_pipeline_1f1b_grads(embed_fn, stage_fn, head_fn,
+                                       num_stages=Pstages,
+                                       num_micro_batches=M, mesh=mesh)
+        batch = jnp.zeros((M * mb, S + 1), jnp.int32)
+        with mesh:
+            audit = hlo_audit.audit_jit(jax.jit(gfn), params, batch,
+                                        jax.random.PRNGKey(1))
+        boundary = mb * S * H * 4                      # [mb, S, H] f32
+        ticks = len(tick_table(M, Pstages))            # M + 2(P-1)
+        assert ticks == M + 2 * (Pstages - 1)
+        loop_perms = audit.in_loops("collective-permute")
+        assert len(loop_perms) == 2, audit.summary()
+        assert all(o.out_bytes == boundary for o in loop_perms), \
+            [o.out_shapes for o in loop_perms]
+        # The COMPILED scan bound must equal the oracle's tick count —
+        # per-step permute bytes = 2 x boundary x ticks then follows
+        # from the two checks above (asserting the product again would
+        # be a tautology: ticks would cancel).
+        assert ticks in audit.while_trip_counts(), \
+            (ticks, audit.while_trip_counts())

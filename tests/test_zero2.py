@@ -73,6 +73,25 @@ class TestZero2GradSharding:
         # or XLA:CPU's all-reduce (+slice into the sharded carry) lowering.
         assert ("reduce-scatter" in txt) or ("all-reduce" in txt)
 
+    def test_reduce_scatter_false_keeps_replicated_grads(self):
+        """``reduce_scatter: false`` honestly selects the dense all-reduce
+        path (reference semantics): no grad shardings, grads materialize
+        replicated — the knob acts instead of being docstring-advisory."""
+        params = simple_model_params(jax.random.PRNGKey(0))
+        cfg = base_config(zero_optimization={"stage": 2,
+                                             "reduce_scatter": False},
+                          gradient_accumulation_steps=2,
+                          train_batch_size=32)
+        engine, *_ = deepspeed_tpu.initialize(
+            model=simple_loss_fn, model_params=params, config=cfg)
+        assert engine._grad_sync_mode == "allreduce"
+        assert engine._grad_shardings() is None
+        engine._build_grad_paths()
+        g, _ = engine._grad_step_fn(engine.state.params, random_batch(n=8),
+                                    jax.random.PRNGKey(1),
+                                    engine.state.loss_scale)
+        assert "data" not in str(g["w1"].sharding.spec)
+
     def test_stage1_keeps_replicated_grads(self):
         """Contrast: stage 1 shards optimizer state but not the grad buffer
         (reference stage1 reduces full grads then scatters ownership)."""

@@ -1,0 +1,385 @@
+#!/usr/bin/env python
+"""Communication audit over the flagship configs — records COMM_AUDIT.json.
+
+Compiles (never runs) each flagship parallel configuration on the virtual
+8-device mesh, walks the compiled HLO for its collectives
+(parallel/hlo_audit.py), compares against the analytic per-config wire
+model, and records the structured result. This is the machine-checked form
+of the repo's central scaling claims:
+
+- **zero1**: optimizer state sharded, grads replicated — grad sync is a
+  dense all-reduce (2(n-1)/n · B wire).
+- **zero2**: grads born dp-sharded — sync must be reduce-scattered
+  ((n-1)/n · B, half the all-reduce wire, grads never materialize
+  unpartitioned). Both the declarative (GSPMD) and explicit
+  (lax.psum_scatter) lowerings are audited; the engine's grad_sync=auto
+  picks whichever is honest on this backend.
+- **onebit**: the in-XLA emulation psums full-precision tensors (recorded
+  as such); the DCN wire format is packed sign bits + per-chunk scales,
+  ~1/32 of dense (ops/onebit.comm_bytes).
+- **pipeline_1f1b**: boundary activations/cotangents ride
+  collective-permute inside the tick scan — bytes/step = 2 · ticks ·
+  boundary, ticks = M + 2(P-1).
+- **ring_attention**: K/V chunks rotate by collective-permute — bytes =
+  2 · sp · chunk per forward.
+
+Usage: python tools/comm_audit.py [--out COMM_AUDIT.json]
+(tools/run_comm_audit.sh wraps this with the tier-1 env.)
+"""
+import argparse
+import json
+import os
+import sys
+
+# The 8-device virtual mesh, exactly like tests/conftest.py — must be set
+# before jax initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax                     # noqa: E402
+import jax.numpy as jnp        # noqa: E402
+import numpy as np             # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import deepspeed_tpu           # noqa: E402
+from deepspeed_tpu.parallel import hlo_audit  # noqa: E402
+from deepspeed_tpu.parallel.topology import build_mesh  # noqa: E402
+
+
+# ------------------------------------------------------------------ #
+# Tiny fixture model (mirror of tests/simple_model.py, kept local so the
+# tool runs without the test tree on path)
+# ------------------------------------------------------------------ #
+def _params(seed=0, dim=8, hidden=16, classes=4):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return {"w1": jax.random.normal(k1, (dim, hidden)) * 0.1,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k2, (hidden, classes)) * 0.1,
+            "b2": jnp.zeros((classes,))}
+
+
+def _loss_fn(params, batch, rng):
+    x, y = batch
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    logits = h @ params["w2"] + params["b2"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    onehot = jax.nn.one_hot(y, logits.shape[-1])
+    return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+
+def _batch(n=16, dim=8, classes=4, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, dim)).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) % classes
+    return (x, y)
+
+
+def _engine(config_overrides, optimizer=None, gas=1):
+    cfg = {"train_batch_size": 16 * gas,
+           "gradient_accumulation_steps": gas,
+           # fused=False keeps the optimizer apply out of the grad-sync
+           # audit (the fused chunked front end has its own collectives,
+           # recorded as a finding below).
+           "optimizer": optimizer or {
+               "type": "Adam", "params": {"lr": 1e-2, "fused": False}},
+           "steps_per_print": 10 ** 9}
+    cfg.update(config_overrides)
+    engine, *_ = deepspeed_tpu.initialize(
+        model=_loss_fn, model_params=_params(), config=cfg)
+    return engine
+
+
+def _audit_train_step(engine, gas=1):
+    batch = _batch(n=16 * gas)
+    mb = engine._stack_micro_batches(batch)
+    mb = jax.device_put(mb, engine._batch_sharding(mb, leading_dims=2))
+    fn = engine._build_train_step()
+    return hlo_audit.audit_jit(fn, engine.state, mb, engine._base_rng)
+
+
+# ------------------------------------------------------------------ #
+# Flagship configs
+# ------------------------------------------------------------------ #
+def audit_zero1():
+    e = _engine({"zero_optimization": {"stage": 1}})
+    audit = _audit_train_step(e)
+    model = hlo_audit.grad_sync_wire_model(
+        jax.device_get(e.state.params), e.dp_size)
+    # Stage 1 replicates grads: the sync must be all-reduce, never
+    # reduce-scatter. "Present" means an all-reduce at least as big as the
+    # LARGEST grad leaf — the always-present 4-byte loss/overflow psums
+    # must not satisfy the check (a removed grad sync has to fail it).
+    biggest_leaf = max(
+        int(np.prod(l.shape)) * 4 for l in
+        jax.tree_util.tree_leaves(jax.device_get(e.state.params)))
+    ar_grad = [o for o in audit.of_kind("all-reduce")
+               if o.payload_bytes >= biggest_leaf]
+    checks = {
+        "no_reduce_scatter": not audit.of_kind("reduce-scatter"),
+        "grad_allreduce_present": bool(ar_grad),
+    }
+    return {
+        "config": {"stage": 1, "dp": e.dp_size, "grad_sync": "n/a"},
+        "hlo": audit.summary(),
+        "model": {"grad_sync_wire_bytes": model["all_reduce_wire_bytes"],
+                  **model},
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
+def audit_zero2():
+    out = {"config": {"stage": 2, "dp": 8}}
+    results = {}
+    for mode in ("declarative", "explicit"):
+        e = _engine({"zero_optimization": {"stage": 2, "grad_sync": mode}})
+        audit = _audit_train_step(e)
+        model = hlo_audit.grad_sync_wire_model(
+            jax.device_get(e.state.params), e.dp_size)
+        rs = audit.of_kind("reduce-scatter")
+        rs_payload = sum(o.payload_bytes for o in rs)
+        rs_wire = sum(o.wire_bytes for o in rs)
+        results[mode] = {
+            "hlo": audit.summary(),
+            "reduce_scatter_payload_bytes": rs_payload,
+            "reduce_scatter_wire_bytes": rs_wire,
+            "model": model,
+            "grad_sync_reduce_scattered":
+                rs_payload == model["scatterable_bytes"],
+        }
+    probe = hlo_audit.zero2_grad_sync_lowering(build_mesh(), "data")
+    e_auto = _engine({"zero_optimization": {"stage": 2}})
+    model = results["explicit"]["model"]
+    checks = {
+        # The engine's default (auto) path must be reduce-scattered with
+        # wire bytes on the analytic model — the tier-1 regression.
+        "auto_mode_guarantees_reduce_scatter":
+            results[e_auto._grad_sync_mode]["grad_sync_reduce_scattered"],
+        "reduce_scatter_wire_is_half_allreduce": abs(
+            model["reduce_scatter_wire_bytes"] /
+            max(1, model["all_reduce_wire_bytes"]) - 0.5) < 0.02,
+        "explicit_lowering_is_reduce_scatter":
+            results["explicit"]["grad_sync_reduce_scattered"],
+    }
+    out.update({
+        "declared_sharding_lowers_to": probe,
+        "auto_resolves_to": e_auto._grad_sync_mode,
+        "paths": results,
+        "checks": checks, "pass": all(checks.values()),
+    })
+    return out
+
+
+def audit_onebit():
+    from deepspeed_tpu.ops.onebit import comm_bytes
+    e = _engine({}, optimizer={
+        "type": "OneBitAdam",
+        "params": {"lr": 1e-3, "freeze_step": 2}})
+    audit = _audit_train_step(e)
+    n_el = sum(int(np.prod(l.shape)) for l in
+               jax.tree_util.tree_leaves(jax.device_get(e.state.params)))
+    dense = comm_bytes(n_el, compressed=False)
+    compressed = comm_bytes(n_el, compressed=True, chunks=e.dp_size)
+    # The ~1/32 claim is about the wire FORMAT (1 sign bit/element + one
+    # f32 scale per chunk) at flagship tensor sizes; the toy engine's
+    # 212-element tree amortizes the scales poorly and is recorded as-is.
+    flagship_el = 1 << 20
+    flagship_ratio = comm_bytes(flagship_el, compressed=False) / \
+        comm_bytes(flagship_el, compressed=True, chunks=e.dp_size)
+    checks = {
+        "flagship_tensor_wire_at_most_1_28th_dense": flagship_ratio >= 28.0,
+        # Honest accounting: the in-XLA emulation psums full-precision
+        # tensors; the audit must SEE those (compression is a DCN wire
+        # format, not an ICI one).
+        "emulation_psums_present": bool(audit.of_kind("all-reduce")),
+    }
+    return {
+        "config": {"optimizer": "OnebitAdam", "dp": e.dp_size,
+                   "phase": "compression (momentum sign-bits + scales)"},
+        "hlo": audit.summary(),
+        "hlo_note": "single-program emulation: the compressed exchange is "
+                    "psum'd at full precision in-XLA; the wire model below "
+                    "is the packed DCN format the 1-bit claims are about "
+                    "(ops/onebit.comm_bytes)",
+        "model": {"elements": n_el, "dense_wire_bytes_per_rank": dense,
+                  "compressed_wire_bytes_per_rank": compressed,
+                  "compression_ratio_dense_over_compressed":
+                      round(dense / compressed, 2),
+                  "flagship_tensor_elements": flagship_el,
+                  "flagship_compression_ratio": round(flagship_ratio, 2)},
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
+def _tiny_pipeline(P=8, M=4, mb=2, H=16, S=4, V=32, dp=1):
+    """Minimal synthetic pipeline for the 1F1B permute-bytes audit:
+    boundary activation is [mb, S, H] f32."""
+    from deepspeed_tpu.runtime.pipe.spmd_1f1b import spmd_pipeline_1f1b_grads
+    mesh = build_mesh(pp=P, dp=dp,
+                      devices=jax.devices()[:P * dp])
+    k = jax.random.PRNGKey(0)
+    params = {
+        "shared": {"wte": jax.random.normal(k, (V, H)) * 0.1},
+        "blocks": {"w": jax.random.normal(k, (P, H, H)) * 0.1},
+    }
+
+    def embed_fn(shared, tokens, rng):
+        return shared["wte"][tokens]
+
+    def stage_fn(blocks, x, rng):
+        return jnp.tanh(x @ blocks["w"][0])
+
+    def head_fn(shared, y, targets, rng):
+        logits = y @ shared["wte"].T
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        onehot = jax.nn.one_hot(targets, logits.shape[-1])
+        return -jnp.mean(jnp.sum(onehot * logp, axis=-1))
+
+    gfn = spmd_pipeline_1f1b_grads(embed_fn, stage_fn, head_fn,
+                                   num_stages=P, num_micro_batches=M,
+                                   mesh=mesh)
+    batch = jnp.zeros((M * mb * dp, S + 1), jnp.int32)
+    boundary_bytes = mb * S * H * 4          # [mb, S, H] f32 per dp rank
+    return gfn, params, batch, mesh, boundary_bytes
+
+
+def _tiny_pipeline_pp_dp(P=4, M=4, dp=2):
+    return _tiny_pipeline(P=P, M=M, dp=dp)
+
+
+def audit_1f1b():
+    P, M = 8, 4
+    gfn, params, batch, mesh, boundary = _tiny_pipeline(P=P, M=M)
+    with mesh:
+        audit = hlo_audit.audit_jit(
+            jax.jit(gfn), params, batch, jax.random.PRNGKey(1))
+    ticks = M + 2 * (P - 1)
+    loop_perms = audit.in_loops("collective-permute")
+    checks = {
+        # one activation rotate up + one cotangent rotate down per tick
+        "two_boundary_permutes_per_tick": len(loop_perms) == 2,
+        "permute_payload_is_boundary": all(
+            o.out_bytes == boundary for o in loop_perms),
+        # the COMPILED scan bound equals the schedule oracle's tick count
+        # (permute bytes/step = 2 x boundary x ticks then follows from
+        # the two payload checks above)
+        "compiled_trip_count_matches_tick_table":
+            ticks in audit.while_trip_counts(),
+    }
+    # ZeRO-1 composition: pp x dp is a partially-manual shard_map (manual
+    # pipe axis + auto dp axis) — old jax cannot compile it; record the
+    # capability honestly instead of asserting by design.
+    try:
+        gfn_pd, p2, b2, mesh_pd, _ = _tiny_pipeline_pp_dp(P=4, M=M, dp=2)
+        with mesh_pd:
+            jax.jit(gfn_pd).lower(p2, b2, jax.random.PRNGKey(1)).compile()
+        zero1_composition = "compiles on this jax (extend the audit)"
+    except NotImplementedError as e:
+        zero1_composition = f"capability-gated: {e}"
+    except Exception as e:   # pragma: no cover
+        zero1_composition = f"{type(e).__name__}: {str(e)[:160]}"
+    return {
+        "config": {"schedule": "1f1b", "pp": P, "micro_batches": M,
+                   "ticks": ticks, "boundary_bytes": boundary},
+        "hlo": audit.summary(),
+        "model": {"permute_bytes_per_step": 2 * boundary * ticks,
+                  "formula": "2 directions x boundary x (M + 2(P-1))"},
+        "zero1_composition_pp_x_dp": zero1_composition,
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
+def audit_ring_attention():
+    from deepspeed_tpu.ops.ring_attention import ring_attention
+    sp, B, S, nH, D = 8, 2, 64, 2, 8
+    mesh = build_mesh(sp=8, dp=1)
+    q = jnp.zeros((B, S, nH, D), jnp.float32)
+    with mesh:
+        audit = hlo_audit.audit_jit(
+            jax.jit(lambda q, k, v: ring_attention(q, k, v, mesh,
+                                                   causal=True)),
+            q, q, q)
+    chunk = B * (S // sp) * nH * D * 4
+    loop_perms = audit.in_loops("collective-permute")
+    checks = {
+        "two_chunk_permutes_per_hop": len(loop_perms) == 2,
+        "permute_payload_is_kv_chunk": all(
+            o.out_bytes == chunk for o in loop_perms),
+    }
+    return {
+        "config": {"sp": sp, "B": B, "S": S, "heads": nH, "head_dim": D,
+                   "kv_chunk_bytes": chunk},
+        "hlo": audit.summary(),
+        "model": {"permute_bytes_per_forward": 2 * sp * chunk,
+                  "formula": "2 tensors (K,V) x sp hops x chunk"},
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
+def audit_fused_chunk_finding():
+    """Audited finding, not a flagship: the fused optimizer's chunked
+    multi-tensor front end concatenates dp-sharded leaves into flat chunk
+    buffers, which GSPMD assembles by gathering the FULL chunk onto every
+    device each step — visible as chunk-sized collectives the per-leaf
+    optax apply does not emit."""
+    e = _engine({"zero_optimization": {"stage": 2}},
+                optimizer={"type": "Adam",
+                           "params": {"lr": 1e-2, "fused": True}})
+    audit = _audit_train_step(e)
+    big = [o for o in audit.ops if o.payload_bytes >= 2 ** 18]
+    return {
+        "fused_chunk_gather_collectives": [
+            {"kind": o.kind, "shapes": o.out_shapes,
+             "payload_bytes": o.payload_bytes, "op_name": o.op_name}
+            for o in big],
+        "note": "optimizer.params.fused under ZeRO sharding assembles "
+                "each flat chunk at full size per device (padded to the "
+                "chunk quantum) — an apply-time transient the audit "
+                "surfaces; grad sync itself is unaffected",
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "COMM_AUDIT.json"))
+    args = ap.parse_args()
+
+    record = {
+        "generated_by": "tools/comm_audit.py",
+        "mesh": {"devices": jax.device_count(),
+                 "backend": jax.devices()[0].platform,
+                 "jax": jax.__version__},
+        "wire_model": "ring: all-reduce 2(g-1)/g*B; reduce-scatter/"
+                      "all-gather (g-1)/g*B; permute B",
+        "configs": {},
+    }
+    for name, fn in [("zero1", audit_zero1), ("zero2", audit_zero2),
+                     ("onebit", audit_onebit),
+                     ("pipeline_1f1b", audit_1f1b),
+                     ("ring_attention", audit_ring_attention)]:
+        print(f"[comm_audit] auditing {name} ...", flush=True)
+        try:
+            record["configs"][name] = fn()
+        except Exception as e:   # pragma: no cover - keep the record whole
+            record["configs"][name] = {
+                "error": f"{type(e).__name__}: {str(e)[:300]}", "pass": False}
+    record["findings"] = {"fused_chunk_gather": audit_fused_chunk_finding()}
+    record["all_pass"] = all(c.get("pass", False)
+                             for c in record["configs"].values())
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    print(json.dumps({k: v.get("pass") for k, v in
+                      record["configs"].items()}, indent=1))
+    print(f"[comm_audit] wrote {args.out}; all_pass={record['all_pass']}")
+    return 0 if record["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

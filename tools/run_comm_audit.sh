@@ -1,0 +1,7 @@
+#!/usr/bin/env bash
+# Communication audit — compiles the flagship configs on the virtual
+# 8-device mesh, checks compiled collectives against the analytic wire
+# models, and records COMM_AUDIT.json (mirrors tools/run_tier1.sh).
+# Exit 0 = every config's lowering matches its model.
+cd "$(dirname "$0")/.." || exit 1
+exec env JAX_PLATFORMS=cpu python tools/comm_audit.py "$@"
