@@ -146,6 +146,61 @@ TENSORBOARD_JOB_NAME = "job_name"
 TENSORBOARD_JOB_NAME_DEFAULT = "DeepSpeedJobName"
 
 #############################################
+# Telemetry (monitor/ subsystem)
+#############################################
+# The "telemetry" block subsumes "tensorboard" (which stays as an alias:
+# a config with only a tensorboard block gets a telemetry sink with the
+# same output_path/job_name). All collection is report-boundary batched —
+# the monitor/ subsystem adds zero host<->device syncs on the hot path.
+TELEMETRY = "telemetry"
+TELEMETRY_ENABLED = "enabled"
+TELEMETRY_ENABLED_DEFAULT = False
+TELEMETRY_OUTPUT_PATH = "output_path"
+TELEMETRY_OUTPUT_PATH_DEFAULT = ""
+TELEMETRY_JOB_NAME = "job_name"
+TELEMETRY_JOB_NAME_DEFAULT = "DeepSpeedJobName"
+# Ring-buffer capacity for per-step records between drains; overflow drops
+# the OLDEST records and the drain reports how many were dropped.
+TELEMETRY_BUFFER_SIZE = "buffer_size"
+TELEMETRY_BUFFER_SIZE_DEFAULT = 1024
+# Drain cadence in global steps; 0 = follow steps_per_print.
+TELEMETRY_REPORT_STEPS = "report_steps"
+TELEMETRY_REPORT_STEPS_DEFAULT = 0
+# Host-side span tracing: path of the Chrome-trace/Perfetto JSON to write
+# ("" = tracing off; span collection costs nothing when off).
+TELEMETRY_TRACE_PATH = "trace_path"
+TELEMETRY_TRACE_PATH_DEFAULT = ""
+# Recompile sentinel: a jit cache miss on an instrumented step function
+# after its warmup calls logs a structured event naming the function and
+# the abstract-signature delta; fail_on_recompile raises instead.
+TELEMETRY_FAIL_ON_RECOMPILE = "fail_on_recompile"
+TELEMETRY_FAIL_ON_RECOMPILE_DEFAULT = False
+# Default 2: call 0 is the cold compile, and call 1 may legitimately
+# recompile once when the donated output state (whose shardings/layouts
+# the compiler chose) becomes the next call's input — steady state starts
+# at call 2.
+TELEMETRY_RECOMPILE_WARMUP = "recompile_warmup_calls"
+TELEMETRY_RECOMPILE_WARMUP_DEFAULT = 2
+# Device-memory watermarks, sampled at report boundaries across ALL local
+# devices and compared against the analytic ZeRO-partitioned model-state
+# footprint: peak > analytic * ratio + slack emits a watermark event.
+TELEMETRY_MEMORY_WATERMARKS = "memory_watermarks"
+TELEMETRY_MEMORY_WATERMARKS_DEFAULT = True
+TELEMETRY_WATERMARK_RATIO = "watermark_ratio"
+TELEMETRY_WATERMARK_RATIO_DEFAULT = 2.0
+TELEMETRY_WATERMARK_SLACK_BYTES = "watermark_slack_bytes"
+TELEMETRY_WATERMARK_SLACK_BYTES_DEFAULT = 256 * 2 ** 20
+# Optional jax.profiler device-trace window: capture num_steps starting at
+# start_step into profile_dir (default: <output_path>/jax_trace).
+# start_step -1 = off.
+TELEMETRY_PROFILE_START_STEP = "profile_start_step"
+TELEMETRY_PROFILE_START_STEP_DEFAULT = -1
+TELEMETRY_PROFILE_NUM_STEPS = "profile_num_steps"
+TELEMETRY_PROFILE_NUM_STEPS_DEFAULT = 1
+TELEMETRY_PROFILE_DIR = "profile_dir"
+TELEMETRY_PROFILE_DIR_DEFAULT = ""
+
+#############################################
 # ZeRO
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"

@@ -1,0 +1,20 @@
+"""monitor/ — the unified telemetry subsystem.
+
+First-class operational visibility for TPU training runs: structured
+per-step records (ring-buffered, drained to JSONL at report boundaries
+with zero added hot-path syncs), host-side Chrome-trace spans, a
+recompile sentinel over the engine's compiled step functions, and
+device-memory watermarks checked against the analytic ZeRO-partitioned
+model-state footprint. See docs/tutorials/telemetry.md.
+"""
+from .memory import (MemoryWatermark, analytic_state_bytes,
+                     device_memory_stats)
+from .recompile import RecompileError, RecompileSentinel
+from .telemetry import JsonlSink, Telemetry
+from .trace import ProfilerWindow, TraceWriter
+
+__all__ = [
+    "Telemetry", "JsonlSink", "TraceWriter", "ProfilerWindow",
+    "RecompileSentinel", "RecompileError", "MemoryWatermark",
+    "analytic_state_bytes", "device_memory_stats",
+]

@@ -1,0 +1,122 @@
+"""Device-memory watermarks vs the analytic ZeRO model-state footprint.
+
+``device_memory_stats`` samples ``memory_stats()`` across ALL local
+devices (the single shared sampler — ``runtime/utils.see_memory_usage``
+and the telemetry drain both use it), aggregating max and sum per field.
+It runs only at report boundaries: each sample is a host API call per
+device, cheap but not free, and watermark math never belongs on the hot
+path.
+
+``analytic_state_bytes`` prices the engine state's per-device HBM from
+sharding METADATA alone (``sharding.shard_shape``): for each leaf, the
+bytes of one device's shard. Under ZeRO the optimizer moments are
+dp-sharded, so the analytic footprint is params + state/dp + scalars —
+the memory story the sharding declarations promise. A measured peak far
+above it (``peak > analytic * ratio + slack``; the slack absorbs
+activations, XLA workspace, and allocator rounding) means the promise
+broke — e.g. a regression replicating the moments — and surfaces as a
+structured ``memory_watermark`` event instead of a silent OOM three
+models later.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+_AGG_FIELDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def device_memory_stats(devices=None) -> Optional[Dict[str, Any]]:
+    """Aggregate ``memory_stats()`` over local devices: per-device list
+    plus ``<field>_max``/``<field>_sum`` for bytes_in_use /
+    peak_bytes_in_use / bytes_limit. Returns None when no device reports
+    stats (e.g. the CPU backend)."""
+    if devices is None:
+        try:
+            import jax
+            devices = jax.local_devices()
+        except Exception:
+            # Backend init failure degrades to "stats unavailable", the
+            # contract see_memory_usage has always had.
+            return None
+    per: List[Dict[str, Any]] = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        per.append({"device": getattr(d, "id", len(per)),
+                    **{f: int(stats.get(f, 0)) for f in _AGG_FIELDS}})
+    if not per:
+        return None
+    out: Dict[str, Any] = {"num_devices": len(per), "per_device": per}
+    for f in _AGG_FIELDS:
+        vals = [p[f] for p in per]
+        out[f"{f}_max"] = max(vals)
+        out[f"{f}_sum"] = sum(vals)
+    return out
+
+
+def analytic_state_bytes(tree: Any) -> int:
+    """Per-device bytes of ``tree`` (max across devices, from sharding
+    metadata — no device access). Unsharded/unaddressable leaves count
+    their full size."""
+    import jax
+    import numpy as np
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shape = getattr(leaf, "shape", None)
+        dtype = getattr(leaf, "dtype", None)
+        if shape is None or dtype is None:
+            continue
+        itemsize = np.dtype(dtype).itemsize
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is not None and hasattr(sharding, "shard_shape"):
+            try:
+                shape = sharding.shard_shape(tuple(shape))
+            except Exception:
+                pass
+        n = itemsize
+        for d in shape:
+            n *= int(d)
+        total += n
+    return total
+
+
+class MemoryWatermark:
+    """Report-boundary watermark check against an analytic footprint."""
+
+    def __init__(self, analytic_bytes: int, ratio: float = 2.0,
+                 slack_bytes: int = 256 * 2 ** 20,
+                 sampler: Callable[[], Optional[Dict[str, Any]]]
+                 = device_memory_stats):
+        self.analytic_bytes = int(analytic_bytes)
+        self.ratio = float(ratio)
+        self.slack_bytes = int(slack_bytes)
+        self.sampler = sampler
+        self.events: List[Dict[str, Any]] = []
+
+    @property
+    def threshold_bytes(self) -> int:
+        return int(self.analytic_bytes * self.ratio) + self.slack_bytes
+
+    def check(self):
+        """Sample and compare. Returns ``(stats_or_None, event_or_None)``;
+        the event is also appended to ``self.events``."""
+        stats = self.sampler()
+        if stats is None:
+            return None, None
+        peak = int(stats.get("peak_bytes_in_use_max", 0))
+        if peak <= self.threshold_bytes:
+            return stats, None
+        event = {
+            "peak_bytes_in_use_max": peak,
+            "analytic_state_bytes": self.analytic_bytes,
+            "threshold_bytes": self.threshold_bytes,
+            "ratio": round(peak / max(1, self.analytic_bytes), 3),
+            "watermark_ratio": self.ratio,
+            "watermark_slack_bytes": self.slack_bytes,
+        }
+        self.events.append(event)
+        return stats, event

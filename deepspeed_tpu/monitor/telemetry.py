@@ -1,0 +1,381 @@
+"""Telemetry core: per-step records in a ring buffer, drained to JSONL at
+report boundaries — with ZERO added host<->device syncs on the hot path.
+
+The hot-path contract (the engine's ``_maybe_log`` discipline, extended):
+
+- ``record_step`` appends the step's metrics dict AS-IS to a bounded ring
+  buffer. jax scalars are async futures — holding them costs a few bytes
+  of device memory and forces nothing.
+- ``maybe_drain`` fires only at report boundaries (``report_steps``,
+  default = ``steps_per_print``): ONE batched ``jax.device_get`` over
+  every buffered scalar, then JSONL writes, the memory-watermark sample,
+  and the trace flush. Between boundaries the subsystem performs no
+  device access of any kind.
+- When the ring overflows before a drain, the OLDEST records drop and the
+  drain's report record says how many (no silent truncation).
+
+The JSONL stream is line records tagged by ``kind``:
+
+- ``meta``   — once per run: dp, zero stage, precision, grad-sync mode,
+  analytic wire bytes/step, analytic per-device model-state bytes.
+- ``step``   — one per train step (loss, lr, loss_scale, overflow,
+  grad_norm, wall_ms, wire_bytes, offload phase timings + overlap
+  fraction when offloading).
+- ``report`` — one per drain: samples/sec window, skipped steps, device
+  memory sample, dropped-record count.
+- ``event``  — recompile sentinel hits, memory watermarks, user events.
+
+``tools/telemetry_report.py`` summarizes a stream into TELEMETRY.json.
+"""
+from __future__ import annotations
+
+import atexit
+import json
+import os
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from .memory import MemoryWatermark, analytic_state_bytes, device_memory_stats
+from .recompile import RecompileSentinel
+from .trace import ProfilerWindow, TraceWriter
+from ..utils.logging import log_dist, logger
+
+
+def _to_py(v: Any) -> Any:
+    """Host-native scalar for JSON (called at drain time, post-sync)."""
+    if isinstance(v, (bool, np.bool_)):
+        return bool(v)
+    if isinstance(v, (int, np.integer)):
+        return int(v)
+    if isinstance(v, (float, np.floating)):
+        return float(v)
+    if isinstance(v, np.ndarray) and v.ndim == 0:
+        return _to_py(v[()])
+    if hasattr(v, "dtype") and getattr(v, "ndim", 1) == 0:
+        return _to_py(np.asarray(v)[()])
+    return v
+
+
+class JsonlSink:
+    """Line-JSON event sink with the resource story the old engine
+    ``_Monitor`` lacked: the file opens on PROCESS 0 ONLY (every SPMD
+    process used to append to the same file), ``close()`` is idempotent,
+    and an atexit hook closes stragglers. Tensorboard scalars ride along
+    when the writer is importable."""
+
+    def __init__(self, output_path: str, job_name: str,
+                 tensorboard: bool = False, is_writer: Optional[bool] = None):
+        if is_writer is None:
+            try:
+                import jax
+                is_writer = jax.process_index() == 0
+            except Exception:
+                is_writer = True
+        self.is_writer = bool(is_writer)
+        self.closed = False
+        self.jsonl = None
+        self.writer = None
+        out = output_path or "./runs"
+        self.path = os.path.join(out, f"{job_name}.jsonl")
+        if not self.is_writer:
+            return
+        os.makedirs(out, exist_ok=True)
+        self.jsonl = open(self.path, "a")
+        if tensorboard:
+            try:
+                from torch.utils.tensorboard import SummaryWriter
+                self.writer = SummaryWriter(log_dir=os.path.join(out, job_name))
+            except Exception:
+                self.writer = None
+        atexit.register(self.close)
+
+    def write(self, rec: Dict[str, Any]) -> None:
+        if self.closed or self.jsonl is None:
+            return
+        self.jsonl.write(json.dumps(rec) + "\n")
+        self.jsonl.flush()
+        if self.writer is not None and rec.get("kind") == "step":
+            step = int(rec.get("step", 0))
+            for k, v in rec.items():
+                if k not in ("kind", "step", "ts") and \
+                        isinstance(v, (int, float)) and \
+                        not isinstance(v, bool):
+                    self.writer.add_scalar(f"Train/{k}", v, step)
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.closed = True
+        atexit.unregister(self.close)
+        if self.jsonl is not None:
+            self.jsonl.close()
+            self.jsonl = None
+        if self.writer is not None:
+            try:
+                self.writer.close()
+            except Exception:
+                pass
+            self.writer = None
+
+
+class Telemetry:
+    """The engine-facing facade over the monitor subsystem. Disabled
+    (default) it is inert: every hot-path method is a single attribute
+    test, no files open, no wrapping happens."""
+
+    def __init__(self, cfg, default_report_steps: int = 10,
+                 meta: Optional[Dict[str, Any]] = None,
+                 is_writer: Optional[bool] = None):
+        self.cfg = cfg
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self.meta: Dict[str, Any] = dict(meta or {})
+        self.step_provider: Callable[[], int] = lambda: -1
+        self.sentinel: Optional[RecompileSentinel] = None
+        self.tracer: Optional[TraceWriter] = None
+        self.watermark: Optional[MemoryWatermark] = None
+        self.sink: Optional[JsonlSink] = None
+        self.profiler: Optional[ProfilerWindow] = None
+        self.dropped_records = 0
+        self.events: List[Dict[str, Any]] = []
+        self._closed = False
+        if not self.enabled:
+            return
+        self.report_steps = int(cfg.report_steps) or \
+            max(1, int(default_report_steps))
+        self._ring: deque = deque(maxlen=int(cfg.buffer_size))
+        self.sink = JsonlSink(cfg.output_path, cfg.job_name,
+                              tensorboard=getattr(cfg, "tensorboard", False),
+                              is_writer=is_writer)
+        if cfg.trace_path:
+            self.tracer = TraceWriter(cfg.trace_path, is_writer=is_writer)
+        # Non-writer SPMD processes keep the sentinel/watermark checks but
+        # skip step-record collection entirely: buffering scalars and
+        # batch-fetching them at drains only to feed a null sink would be
+        # pinned memory and a pointless device round trip per boundary.
+        self._collect = self.sink.is_writer or self.tracer is not None
+        self.sentinel = RecompileSentinel(
+            warmup_calls=cfg.recompile_warmup_calls,
+            fail_on_recompile=cfg.fail_on_recompile,
+            on_event=self._on_recompile)
+        if int(cfg.profile_start_step) >= 0:
+            out = cfg.profile_dir or os.path.join(
+                cfg.output_path or "./runs", "jax_trace")
+            self.profiler = ProfilerWindow(cfg.profile_start_step,
+                                           cfg.profile_num_steps, out)
+        self._meta_written = False
+        atexit.register(self.close)
+
+    # ------------------------------------------------------------------ #
+    # Hot path (per step): append-only, no device access
+    # ------------------------------------------------------------------ #
+    def record_step(self, step: int, metrics: Dict[str, Any],
+                    **host_fields: Any) -> None:
+        """Buffer one step's record. ``metrics`` values may be (and on the
+        jitted paths are) un-fetched jax scalars; they sync only at the
+        next drain."""
+        if not self.enabled or not self._collect:
+            return
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped_records += 1
+        self._ring.append((int(step), time.time(), dict(metrics),
+                           host_fields))
+
+    def profiler_tick(self, step: int) -> None:
+        if self.profiler is not None:
+            self.profiler.tick(step)
+
+    def span(self, name: str, **args):
+        """Host-span context manager (no-op without a trace_path)."""
+        if self.tracer is None:
+            return nullcontext()
+        return self.tracer.span(name, **args)
+
+    def add_span(self, name: str, t_start: float, dur_s: float,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        if self.tracer is not None:
+            self.tracer.add_span(name, t_start, dur_s, args=args)
+
+    def instrument_step_fn(self, name: str, fn: Callable) -> Callable:
+        """Recompile-sentinel wrapping for a compiled step function;
+        identity when telemetry is disabled."""
+        if self.sentinel is None:
+            return fn
+        return self.sentinel.instrument(name, fn)
+
+    def raise_pending(self) -> None:
+        """Surface a deferred fail_on_recompile violation (see
+        RecompileSentinel.raise_pending — the raise must happen AFTER the
+        caller stored the donated step's returned state)."""
+        if self.sentinel is not None:
+            self.sentinel.raise_pending()
+
+    # ------------------------------------------------------------------ #
+    # Offload trace synthesis: spans from the ALREADY-fenced per-bucket
+    # timings run_bucketed_step measured — no new fences.
+    # ------------------------------------------------------------------ #
+    def add_offload_trace(self, timings: Dict[str, Any]) -> None:
+        if self.tracer is None or not timings:
+            return
+        origin = timings.get("t_origin")
+        pb = timings.get("per_bucket")
+        t0s = timings.get("per_bucket_t0")
+        if origin is None or not pb or not t0s:
+            return
+        phase_names = {"d2h_ms": "offload_d2h", "norm_ms": "offload_norm",
+                       "adam_ms": "offload_adam", "h2d_ms": "offload_h2d"}
+        for key, span_name in phase_names.items():
+            starts = t0s.get(key.replace("_ms", "_t0"))
+            durs = pb.get(key)
+            if starts is None or durs is None:
+                continue
+            for b, (t0, ms) in enumerate(zip(starts, durs)):
+                if ms <= 0.0:
+                    continue
+                self.tracer.add_span(f"{span_name} b{b}", origin + t0,
+                                     ms / 1e3,
+                                     tid=self.tracer.lane(span_name))
+
+    # ------------------------------------------------------------------ #
+    # Events (immediate write — rare, structured)
+    # ------------------------------------------------------------------ #
+    def event(self, kind: str, payload: Dict[str, Any]) -> None:
+        if not self.enabled:
+            return
+        rec = {"kind": "event", "event": kind,
+               "step": int(self.step_provider()), "ts": time.time(),
+               **payload}
+        self.events.append(rec)
+        self._write(rec)
+        if self.tracer is not None:
+            self.tracer.instant(kind, args=payload)
+
+    def _on_recompile(self, event: Dict[str, Any]) -> None:
+        log_dist(
+            f"telemetry: recompile of '{event['fn']}' after warmup "
+            f"(compile #{event['total_compiles']}); signature delta: "
+            + "; ".join(event["signature_delta"]), ranks=[0])
+        self.event("recompile", event)
+
+    @property
+    def recompile_count(self) -> int:
+        return self.sentinel.recompile_count if self.sentinel else 0
+
+    # ------------------------------------------------------------------ #
+    # Report boundary
+    # ------------------------------------------------------------------ #
+    def set_analytic_footprint(self, nbytes: int,
+                               sampler: Optional[Callable] = None) -> None:
+        """Arm the memory watermark with the analytic per-device
+        model-state bytes (see monitor/memory.py)."""
+        if not self.enabled or not self.cfg.memory_watermarks:
+            return
+        self.watermark = MemoryWatermark(
+            nbytes, ratio=self.cfg.watermark_ratio,
+            slack_bytes=self.cfg.watermark_slack_bytes,
+            sampler=sampler or device_memory_stats)
+        self.meta["analytic_state_bytes"] = int(nbytes)
+
+    def maybe_drain(self, step: int,
+                    extra: Optional[Dict[str, Any]] = None,
+                    extra_fn: Optional[Callable[[], Dict[str, Any]]] = None
+                    ) -> bool:
+        """Drain iff ``step`` is a report boundary. ``extra_fn`` is only
+        invoked when the drain fires — callers can defer work (e.g. a
+        counter sync) that must not run on non-boundary steps."""
+        if not self.enabled or step % self.report_steps != 0:
+            return False
+        if extra is None and extra_fn is not None:
+            extra = extra_fn()
+        self.drain(extra)
+        return True
+
+    def drain(self, extra: Optional[Dict[str, Any]] = None) -> None:
+        """Flush the ring to JSONL: one batched device_get for every
+        buffered scalar, then the memory sample + watermark check."""
+        if not self.enabled:
+            return
+        self._ensure_meta()
+        recs = list(self._ring)
+        self._ring.clear()
+        # One sync for the whole window.
+        import jax
+        pending = []
+        for _, _, metrics, _ in recs:
+            for v in metrics.values():
+                if isinstance(v, jax.Array):
+                    pending.append(v)
+        fetched = iter(jax.device_get(pending)) if pending else iter(())
+        for step, ts, metrics, host_fields in recs:
+            rec: Dict[str, Any] = {"kind": "step", "step": step, "ts": ts}
+            for k, v in metrics.items():
+                rec[k] = _to_py(next(fetched) if isinstance(v, jax.Array)
+                                else v)
+            for k, v in host_fields.items():
+                rec[k] = _to_py(v) if not isinstance(v, dict) else v
+            self._write(rec)
+        report: Dict[str, Any] = {
+            "kind": "report", "step": int(self.step_provider()),
+            "ts": time.time(), "records": len(recs),
+            "dropped_records": self.dropped_records,
+        }
+        self.dropped_records = 0
+        if extra:
+            report.update({k: _to_py(v) if not isinstance(v, dict) else v
+                           for k, v in extra.items()})
+        if self.watermark is not None:
+            stats, wm_event = self.watermark.check()
+            report["memory"] = stats if stats is not None \
+                else {"available": False}
+            if wm_event is not None:
+                logger.warning(
+                    "telemetry: device memory watermark exceeded — peak "
+                    f"{wm_event['peak_bytes_in_use_max'] / 2**30:.2f} GB vs "
+                    f"analytic model-state "
+                    f"{wm_event['analytic_state_bytes'] / 2**30:.2f} GB "
+                    f"(x{wm_event['ratio']}); a sharding regression can "
+                    "look exactly like this")
+                self.event("memory_watermark", wm_event)
+        self._write(report)
+        if self.tracer is not None:
+            self.tracer.flush()
+
+    def _ensure_meta(self) -> None:
+        if self._meta_written:
+            return
+        self._meta_written = True
+        self._write({"kind": "meta", "ts": time.time(), **self.meta})
+
+    def _write(self, rec: Dict[str, Any]) -> None:
+        if self.sink is not None:
+            self.sink.write(rec)
+
+    # ------------------------------------------------------------------ #
+    def close(self) -> None:
+        if not self.enabled or self._closed:
+            return
+        if self._ring:
+            self.drain()
+        else:
+            self._ensure_meta()
+        self._closed = True
+        # Release process-lifetime anchors: the atexit hook keeps this
+        # object (and anything its callbacks close over) alive, so a
+        # closed Telemetry must unhook itself and drop the engine-side
+        # step_provider closure — otherwise every engine ever built with
+        # telemetry enabled pins its full device state until exit.
+        atexit.unregister(self.close)
+        self.step_provider = lambda: -1
+        if self.profiler is not None:
+            self.profiler.stop()
+        if self.tracer is not None:
+            self.tracer.close()
+        if self.sink is not None:
+            self.sink.close()
+
+
+__all__ = ["Telemetry", "JsonlSink", "analytic_state_bytes",
+           "device_memory_stats"]

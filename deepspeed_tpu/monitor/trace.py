@@ -1,0 +1,182 @@
+"""Host-side span tracing: Chrome-trace/Perfetto JSON + jax.profiler window.
+
+Spans are HOST wall-clock intervals (dispatch time, host Adam, D2H waits,
+checkpoint IO) recorded with two ``perf_counter`` reads — never a device
+fence. On the fused jitted paths the device-side phases (grad compute /
+grad sync / optimizer apply) live inside one XLA program and are not
+host-observable without fences; the honest device-side view is the
+optional ``jax.profiler`` window (``ProfilerWindow``), which captures the
+XLA execution trace for N configured steps.
+
+The output is the Chrome Trace Event format ("traceEvents" array of
+complete/instant events), loadable in Perfetto (ui.perfetto.dev) or
+chrome://tracing.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Any, Dict, List, Optional
+
+from ..utils.logging import logger
+
+# Stable lane (tid) assignment so related spans stack in one row each in
+# the Perfetto UI; unknown span names land in lane 0.
+_LANES = {
+    "train_batch": 0, "data_prep": 1, "step_dispatch": 2,
+    "grad_compute": 2, "grad_sync": 3, "optimizer_apply": 4,
+    "offload_step": 2, "offload_d2h": 3, "offload_norm": 4,
+    "offload_adam": 5, "offload_h2d": 6,
+    "checkpoint_save": 7, "checkpoint_load": 7,
+}
+
+
+class TraceWriter:
+    """Chrome-trace writer in the JSON **array** format: events append to
+    the file incrementally at each flush (the buffer then clears, so
+    memory and per-flush IO stay O(events-since-last-flush), not
+    O(run-length)); the array stays unterminated until ``close()``, which
+    the trace format explicitly permits — a crashed run's partial file
+    still loads in Perfetto. Non-writer processes buffer nothing."""
+
+    def __init__(self, path: str, is_writer: Optional[bool] = None):
+        if is_writer is None:
+            try:
+                import jax
+                is_writer = jax.process_index() == 0
+            except Exception:
+                is_writer = True
+        self.path = path
+        self.is_writer = bool(is_writer)
+        self._events: List[Dict[str, Any]] = []
+        self._file = None
+        self._t0 = time.perf_counter()
+        self._pid = os.getpid()
+        self._lock = threading.Lock()
+        self.closed = False
+
+    # ------------------------------------------------------------------ #
+    def _ts_us(self, t_abs: float) -> float:
+        return (t_abs - self._t0) * 1e6
+
+    def lane(self, name: str) -> int:
+        return _LANES.get(name, 0)
+
+    def add_span(self, name: str, t_start: float, dur_s: float,
+                 tid: Optional[int] = None,
+                 args: Optional[Dict[str, Any]] = None) -> None:
+        """Record a completed span from absolute ``perf_counter`` seconds."""
+        if self.closed or not self.is_writer:
+            return
+        ev = {"name": name, "ph": "X", "pid": self._pid,
+              "tid": self.lane(name) if tid is None else tid,
+              "ts": self._ts_us(t_start), "dur": max(0.0, dur_s * 1e6)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, args: Optional[Dict[str, Any]] = None,
+                t_abs: Optional[float] = None) -> None:
+        if self.closed or not self.is_writer:
+            return
+        ev = {"name": name, "ph": "i", "s": "p", "pid": self._pid, "tid": 0,
+              "ts": self._ts_us(time.perf_counter()
+                                if t_abs is None else t_abs)}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    @contextmanager
+    def span(self, name: str, **args):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add_span(name, t0, time.perf_counter() - t0,
+                          args=args or None)
+
+    # ------------------------------------------------------------------ #
+    def flush(self) -> None:
+        if not self.is_writer or self.closed:
+            return
+        with self._lock:
+            events, self._events = self._events, []
+        if not events:
+            return
+        if self._file is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._file = open(self.path, "w")
+            self._file.write("[\n")
+        for ev in events:
+            self._file.write(json.dumps(ev) + ",\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self.closed:
+            return
+        self.flush()
+        if self._file is not None:
+            # Terminate the array with a sentinel (no trailing comma) so
+            # the closed file is strict JSON; pre-close files are the
+            # unterminated array form Perfetto accepts.
+            self._file.write(json.dumps(
+                {"name": "trace_end", "ph": "i", "s": "p",
+                 "pid": self._pid, "tid": 0,
+                 "ts": self._ts_us(time.perf_counter())}) + "]\n")
+            self._file.close()
+            self._file = None
+        self.closed = True
+
+
+class ProfilerWindow:
+    """Capture a ``jax.profiler`` device trace for ``num_steps`` steps
+    starting at ``start_step`` — the device-side complement to the host
+    spans. ``tick(step)`` is two int compares on the hot path."""
+
+    def __init__(self, start_step: int, num_steps: int, out_dir: str):
+        self.start_step = int(start_step)
+        self.stop_step = int(start_step) + max(1, int(num_steps))
+        self.out_dir = out_dir
+        self._active = False
+        self.failed = False
+
+    def tick(self, step: int) -> None:
+        if self.failed:
+            return
+        # Range check, not equality: a run resumed from a checkpoint past
+        # start_step (the first tick arrives mid-window or later) must
+        # still capture whatever remains of the window instead of
+        # silently never profiling.
+        if not self._active and self.start_step <= step < self.stop_step:
+            try:
+                import jax
+                os.makedirs(self.out_dir, exist_ok=True)
+                jax.profiler.start_trace(self.out_dir)
+                self._active = True
+            except Exception as e:  # pragma: no cover - backend-dependent
+                self.failed = True
+                logger.warning(f"telemetry: jax.profiler trace failed to "
+                               f"start ({type(e).__name__}: {e})")
+        elif self._active and step >= self.stop_step:
+            self.stop()
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        self._active = False
+        try:
+            import jax
+            jax.profiler.stop_trace()
+            logger.info(f"telemetry: jax.profiler trace written to "
+                        f"{self.out_dir}")
+        except Exception as e:  # pragma: no cover - backend-dependent
+            self.failed = True
+            logger.warning(f"telemetry: jax.profiler trace failed to stop "
+                           f"({type(e).__name__}: {e})")

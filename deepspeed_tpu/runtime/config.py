@@ -76,6 +76,76 @@ class TensorboardConfig:
         self.job_name = get(d, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
 
 
+class TelemetryConfig:
+    """The ``telemetry`` block (monitor/ subsystem).
+
+    Subsumes the ``tensorboard`` block, which stays as an alias: a config
+    with only ``tensorboard.enabled`` gets an enabled telemetry sink with
+    the tensorboard block's output_path/job_name (and the tensorboard
+    writer itself, when importable). An explicit ``telemetry`` key always
+    wins over the alias.
+    """
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None,
+                 tensorboard: Optional[TensorboardConfig] = None):
+        d = (param_dict or {}).get(C.TELEMETRY, {})
+        tb = tensorboard or TensorboardConfig(param_dict)
+        get = config_utils.get_scalar_param
+        self.enabled = get(d, C.TELEMETRY_ENABLED, bool(tb.enabled))
+        self.output_path = get(d, C.TELEMETRY_OUTPUT_PATH,
+                               tb.output_path or
+                               C.TELEMETRY_OUTPUT_PATH_DEFAULT)
+        self.job_name = get(d, C.TELEMETRY_JOB_NAME,
+                            tb.job_name if tb.enabled
+                            else C.TELEMETRY_JOB_NAME_DEFAULT)
+        self.tensorboard = bool(tb.enabled)
+        self.buffer_size = get(d, C.TELEMETRY_BUFFER_SIZE,
+                               C.TELEMETRY_BUFFER_SIZE_DEFAULT)
+        self.report_steps = get(d, C.TELEMETRY_REPORT_STEPS,
+                                C.TELEMETRY_REPORT_STEPS_DEFAULT)
+        self.trace_path = get(d, C.TELEMETRY_TRACE_PATH,
+                              C.TELEMETRY_TRACE_PATH_DEFAULT)
+        self.fail_on_recompile = get(d, C.TELEMETRY_FAIL_ON_RECOMPILE,
+                                     C.TELEMETRY_FAIL_ON_RECOMPILE_DEFAULT)
+        self.recompile_warmup_calls = get(d, C.TELEMETRY_RECOMPILE_WARMUP,
+                                          C.TELEMETRY_RECOMPILE_WARMUP_DEFAULT)
+        self.memory_watermarks = get(d, C.TELEMETRY_MEMORY_WATERMARKS,
+                                     C.TELEMETRY_MEMORY_WATERMARKS_DEFAULT)
+        self.watermark_ratio = get(d, C.TELEMETRY_WATERMARK_RATIO,
+                                   C.TELEMETRY_WATERMARK_RATIO_DEFAULT)
+        self.watermark_slack_bytes = get(
+            d, C.TELEMETRY_WATERMARK_SLACK_BYTES,
+            C.TELEMETRY_WATERMARK_SLACK_BYTES_DEFAULT)
+        self.profile_start_step = get(d, C.TELEMETRY_PROFILE_START_STEP,
+                                      C.TELEMETRY_PROFILE_START_STEP_DEFAULT)
+        self.profile_num_steps = get(d, C.TELEMETRY_PROFILE_NUM_STEPS,
+                                     C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT)
+        self.profile_dir = get(d, C.TELEMETRY_PROFILE_DIR,
+                               C.TELEMETRY_PROFILE_DIR_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        if not isinstance(self.buffer_size, int) or self.buffer_size <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_BUFFER_SIZE} must be a "
+                f"positive int, got {self.buffer_size!r}")
+        if not isinstance(self.report_steps, int) or self.report_steps < 0:
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_REPORT_STEPS} must be a "
+                f"non-negative int (0 = follow steps_per_print), got "
+                f"{self.report_steps!r}")
+        if not isinstance(self.recompile_warmup_calls, int) or \
+                self.recompile_warmup_calls < 0:
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_RECOMPILE_WARMUP} must be a "
+                f"non-negative int, got {self.recompile_warmup_calls!r}")
+        if not isinstance(self.watermark_ratio, (int, float)) or \
+                self.watermark_ratio <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_WATERMARK_RATIO} must be a "
+                f"positive number, got {self.watermark_ratio!r}")
+
+
 class MeshConfig:
     """TPU-native extension: requested logical mesh axis sizes.
 
@@ -193,6 +263,8 @@ class DeepSpeedConfig:
         self.pld_config = ProgressiveLayerDropConfig(d)
         self.pipeline_config = PipelineConfig(d)
         self.tensorboard_config = TensorboardConfig(d)
+        self.telemetry_config = TelemetryConfig(
+            d, tensorboard=self.tensorboard_config)
         self.mesh_config = MeshConfig(d)
 
         fp16 = d.get(C.FP16, {})

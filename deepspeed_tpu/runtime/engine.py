@@ -52,6 +52,8 @@ from .utils import (clip_coefficient, clip_grad_norm_, global_norm,
                     tree_has_inf_or_nan)
 from .zero.partition import zero_shardings
 from .. import constants as C
+from ..monitor import Telemetry
+from ..monitor.memory import analytic_state_bytes
 from ..ops.optimizers import build_optimizer
 from ..parallel import comm
 from ..parallel.topology import build_mesh, DP_AXIS, MP_AXIS
@@ -509,7 +511,6 @@ class DeepSpeedEngine:
             batch_size=self.train_micro_batch_size_per_gpu() * self.dp_size,
             start_step=2, steps_per_output=self.steps_per_print(),
             synchronized=self.wall_clock_breakdown())
-        self._monitor = _Monitor(self.config)
 
         # Grad buffer for the forward/backward/step compatibility API.
         self._accum_grads = None
@@ -539,7 +540,38 @@ class DeepSpeedEngine:
         # bytes each lowering costs per step — instead of treating
         # reduce_scatter/overlap_comm as docstring-advisory knobs.
         self._grad_sync_mode = self._resolve_grad_sync()
+        self._wire_bytes, self._wire_detail = self._grad_wire_bytes()
         self._log_comm_plan()
+
+        # Telemetry (monitor/): per-step records + spans + recompile
+        # sentinel + memory watermarks. Inert when disabled; when enabled,
+        # all device access is batched at report boundaries (zero added
+        # hot-path syncs — the _maybe_log discipline, subsystem-wide).
+        self.telemetry = Telemetry(
+            self.config.telemetry_config,
+            default_report_steps=self.steps_per_print(),
+            meta=dict(
+                dp=self.dp_size,
+                zero_stage=self.zero_optimization_stage(),
+                precision=self.config.precision_dtype,
+                cpu_offload=self._offload is not None,
+                grad_sync_mode=self._grad_sync_mode,
+                wire_bytes_per_step=self._wire_bytes,
+                wire_detail=self._wire_detail,
+                train_batch_size=self.train_batch_size(),
+                gradient_accumulation_steps=
+                self.gradient_accumulation_steps()))
+        # Weakref, not a bound closure: the Telemetry outlives engines via
+        # its atexit flush hook, and a strong closure here would pin the
+        # engine's entire device state for process lifetime.
+        import weakref
+        _engine_ref = weakref.ref(self)
+        self.telemetry.step_provider = lambda: (
+            _engine_ref().global_steps if _engine_ref() is not None else -1)
+        # Analytic per-device model-state footprint from the committed
+        # shardings (host metadata only) — the watermark baseline.
+        self.telemetry.set_analytic_footprint(
+            analytic_state_bytes(self.state))
 
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
@@ -667,6 +699,55 @@ class DeepSpeedEngine:
         lowering = hlo_audit.zero2_grad_sync_lowering(self.mesh, DP_AXIS)
         return "declarative" if lowering == "reduce-scatter" else "explicit"
 
+    def _grad_wire_bytes(self) -> Tuple[int, str]:
+        """(analytic wire bytes/step, detail) for the RESOLVED gradient
+        sync — the PR-3 wire model priced at the lowering this engine
+        actually runs. One source of truth for the init log, the
+        telemetry meta/records, and bench's dp_comm provenance."""
+        self._wire_model = None
+        if self.dp_size <= 1:
+            return 0, "single replica (no gradient sync)"
+        from ..parallel import hlo_audit
+        if self._sparse_mask is not None:
+            # Sparse embedding grads travel the data-dependent CSR
+            # exchange (volume ~ nnz_rows/vocab of dense; see
+            # sparse_comm_stats) — pricing them at the dense model would
+            # overstate wire by orders of magnitude. Model the dense
+            # leaves only and say so.
+            dense_leaves = [
+                l for l, m in zip(
+                    jax.tree_util.tree_leaves(self.state.params),
+                    jax.tree_util.tree_leaves(self._sparse_mask)) if not m]
+            model = hlo_audit.grad_sync_wire_model(dense_leaves,
+                                                   self.dp_size)
+            self._wire_model = model
+            return model["all_reduce_wire_bytes"], \
+                ("dense all-reduce over non-sparse leaves only (sparse "
+                 "embedding grads use the data-dependent CSR exchange; "
+                 "see sparse_comm_stats)")
+        model = hlo_audit.grad_sync_wire_model(self.state.params,
+                                               self.dp_size)
+        self._wire_model = model
+        if self.zero_optimization_stage() < 2:
+            return model["all_reduce_wire_bytes"], \
+                "dense all-reduce (grads replicated below ZeRO stage 2)"
+        mode = self._grad_sync_mode
+        if mode == "allreduce":
+            return model["all_reduce_wire_bytes"], \
+                "dense all-reduce (reduce_scatter: false)"
+        declared = hlo_audit.zero2_grad_sync_lowering(self.mesh, DP_AXIS)
+        if mode == "declarative" and declared == "all-reduce":
+            # The user pinned the declarative path on a backend whose
+            # partitioner regresses it: report the wire it actually
+            # costs, not the wire the declaration hoped for.
+            return model["all_reduce_wire_bytes"], \
+                ("declarative — REGRESSED to all-reduce + slice "
+                 "on this backend (grad_sync: auto or explicit "
+                 "restores the reduce-scatter)")
+        return model["reduce_scatter_wire_bytes"], \
+            (f"{mode} reduce-scatter (declared sharding "
+             f"lowers to {declared} on this backend)")
+
     def _log_comm_plan(self) -> None:
         """Init-time communication honesty (audited lowering + analytic
         wire bytes/step) — the knobs act or report, never silently."""
@@ -679,31 +760,11 @@ class DeepSpeedEngine:
                 "pipeline under cpu_offload", ranks=[0])
         if self.zero_optimization_stage() < 2 or self.dp_size <= 1:
             return
-        from ..parallel import hlo_audit
-        model = hlo_audit.grad_sync_wire_model(self.state.params,
-                                               self.dp_size)
-        mode = self._grad_sync_mode
-        if mode == "allreduce":
-            wire = model["all_reduce_wire_bytes"]
-            detail = "dense all-reduce (reduce_scatter: false)"
-        else:
-            declared = hlo_audit.zero2_grad_sync_lowering(self.mesh, DP_AXIS)
-            if mode == "declarative" and declared == "all-reduce":
-                # The user pinned the declarative path on a backend whose
-                # partitioner regresses it: report the wire it actually
-                # costs, not the wire the declaration hoped for.
-                wire = model["all_reduce_wire_bytes"]
-                detail = ("declarative — REGRESSED to all-reduce + slice "
-                          "on this backend (grad_sync: auto or explicit "
-                          "restores the reduce-scatter)")
-            else:
-                wire = model["reduce_scatter_wire_bytes"]
-                detail = (f"{mode} reduce-scatter (declared sharding "
-                          f"lowers to {declared} on this backend)")
         log_dist(
-            f"ZeRO-2 grad sync: {detail}; ~{wire:,} wire bytes/step vs "
-            f"{model['all_reduce_wire_bytes']:,} for a full all-reduce "
-            f"(dp={self.dp_size})", ranks=[0])
+            f"ZeRO-2 grad sync: {self._wire_detail}; "
+            f"~{self._wire_bytes:,} wire bytes/step vs "
+            f"{self._wire_model['all_reduce_wire_bytes']:,} for a full "
+            f"all-reduce (dp={self.dp_size})", ranks=[0])
 
     def _grad_shardings(self):
         """ZeRO stage>=2 gradient shardings over dp (None for stage < 2,
@@ -1018,8 +1079,9 @@ class DeepSpeedEngine:
         import time as _time
         from .zero.offload import grad_to_host, run_bucketed_step
         if self._offload_grad_fn is None:
-            self._offload_grad_fn = self._build_offload_grad_fn(
-                bucketed=self._offload_overlap)
+            self._offload_grad_fn = self.telemetry.instrument_step_fn(
+                "offload_grad_step",
+                self._build_offload_grad_fn(bucketed=self._offload_overlap))
         off = self._offload
         multihost = jax.process_count() > 1
         t_pre = _time.perf_counter()
@@ -1341,16 +1403,19 @@ class DeepSpeedEngine:
 
     def _train_batch_sparse(self, micro_batches):
         if self._sparse_grad_fn is None:
-            self._sparse_grad_fn = self._build_sparse_grad_fn()
-            self._sparse_apply_fn = self._build_sparse_apply_fn()
+            self._sparse_grad_fn = self.telemetry.instrument_step_fn(
+                "sparse_grad_step", self._build_sparse_grad_fn())
+            self._sparse_apply_fn = self.telemetry.instrument_step_fn(
+                "sparse_apply_step", self._build_sparse_apply_fn())
         scale = self.state.loss_scale
         grads, loss = self._sparse_grad_fn(
             self.state.params, jnp.asarray(self.global_steps, jnp.int32),
             micro_batches, self._base_rng, scale)
         inv = 1.0 / float(jax.device_get(scale)) \
             if self.config.fp16_enabled else 1.0
-        grads, shipped, dense_n, sp_overflow = self._csr_exchange(
-            grads, inv_scale=inv)
+        with self.telemetry.span("grad_sync", path="csr_exchange"):
+            grads, shipped, dense_n, sp_overflow = self._csr_exchange(
+                grads, inv_scale=inv)
         self.sparse_comm_stats = {"sparse_elements": int(shipped),
                                   "dense_elements": int(dense_n)}
         self.state, grad_norm, lr, overflow, scale_out = \
@@ -1800,10 +1865,17 @@ class DeepSpeedEngine:
         ``batch``: pytree with leading dim ``gas * micro * dp_local``; or pull
         ``gas`` micro-batches from ``data_iter`` / the engine's dataloader.
         """
+        tl = self.telemetry
+        t_wall0 = time.perf_counter()
+        tl.profiler_tick(self.global_steps)
         sparse_path = self._sparse_mask is not None and self.dp_size > 1
         if self._train_step_fn is None and self._offload is None \
                 and not sparse_path:
-            self._train_step_fn = self._build_train_step()
+            # Recompile-sentinel instrumentation (a no-op pass-through
+            # when telemetry is off): a jit cache miss after warmup is an
+            # unexpected retrace — logged, optionally fatal.
+            self._train_step_fn = tl.instrument_step_fn(
+                "train_step", self._build_train_step())
 
         if batch is None:
             it = data_iter
@@ -1844,8 +1916,12 @@ class DeepSpeedEngine:
         if (self.flops_profiler is not None and
                 self.global_steps == self.config.flops_profiler_config.profile_step):
             self._run_flops_profiler(micro_batches)
+        if tl.tracer is not None:
+            tl.add_span("data_prep", t_wall0,
+                        time.perf_counter() - t_wall0)
 
         self.tput_timer.start()
+        t_dispatch = time.perf_counter()
         if self._offload is not None:
             metrics = self._train_batch_offload(micro_batches)
         elif sparse_path:
@@ -1862,11 +1938,69 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "step"):
             self.lr_scheduler.last_batch_iteration = self.global_steps - 1
         self.tput_timer.stop()
+        self._record_telemetry(metrics, t_wall0, t_dispatch)
         self._maybe_log(metrics)
         return metrics["loss"]
 
     # Alias matching common JAX naming.
     train_step = train_batch
+
+    def _record_telemetry(self, metrics, t0: float, t_dispatch: float) -> None:
+        """Buffer this step's telemetry record — append-only, no device
+        access (the metrics dict's jax scalars ride as futures and sync
+        at the next report-boundary drain). ``wall_ms`` is host wall from
+        train_batch entry; on the jitted paths that is DISPATCH wall
+        (steps pipeline asynchronously — the fenced truth is the
+        throughput timer's window average in the report record), on the
+        host-synchronous offload path it is true step wall."""
+        tl = self.telemetry
+        if not tl.enabled:
+            return
+        # Deferred fail_on_recompile surfaces HERE — after the donated
+        # step's returned state was stored, so a caught RecompileError
+        # leaves the engine usable (e.g. to checkpoint before dying).
+        tl.raise_pending()
+        t_now = time.perf_counter()
+        host: Dict[str, Any] = {
+            "wall_ms": (t_now - t0) * 1e3,
+            "wire_bytes": self._wire_bytes,
+            "samples": self.train_batch_size(),
+        }
+        if self._offload is not None and self.offload_timings:
+            t = self.offload_timings
+            off = {k: round(float(t[k]), 3) for k in (
+                "device_step_ms", "d2h_ms", "host_norm_ms", "host_step_ms",
+                "h2d_dispatch_ms", "h2d_wait_ms", "wall_ms") if k in t}
+            off["overlap_fraction"] = round(
+                float(t.get("overlap_fraction", 0.0)), 4)
+            off["num_buckets"] = int(t.get("num_buckets", 1))
+            off["overlapped"] = bool(t.get("overlapped", False))
+            host["offload"] = off
+            tl.add_offload_trace(t)
+        if tl.tracer is not None:
+            name = "offload_step" if self._offload is not None \
+                else "step_dispatch"
+            tl.add_span(name, t_dispatch, t_now - t_dispatch,
+                        args={"step": self.global_steps})
+            tl.add_span("train_batch", t0, t_now - t0,
+                        args={"step": self.global_steps})
+        tl.record_step(self.global_steps, metrics, **host)
+
+    def _report_extra(self) -> Dict[str, Any]:
+        """Report-boundary fields for the telemetry drain record. Called
+        ONLY at a drain boundary (the skipped_steps read is a sync)."""
+        extra: Dict[str, Any] = {
+            "global_samples": self.global_samples,
+            "samples_per_sec": self.tput_timer.avg_samples_per_sec(),
+            "samples_per_sec_valid": self.tput_timer.has_samples(),
+        }
+        if self._offload is not None:
+            extra["skipped_steps"] = self._offload.skipped_steps
+        else:
+            self.skipped_steps = int(
+                jax.device_get(self.state.skipped_steps))
+            extra["skipped_steps"] = self.skipped_steps
+        return extra
 
     def eval_batch(self, batch, rng=None):
         if self._eval_step_fn is None:
@@ -1881,13 +2015,21 @@ class DeepSpeedEngine:
         forward+backward+optimizer in one analytic pass, no monkey-patching)."""
         from ..profiling.flops_profiler import profile_fn
         cfg = self.config.flops_profiler_config
+        # The sentinel wrapper keeps the raw jitted fn on __wrapped__;
+        # profile the raw fn so the jaxpr walk sees the same callable
+        # either way (and the profiling trace is not counted as a call).
         step_fn = self._train_step_fn
+        step_fn = getattr(step_fn, "__wrapped__", step_fn)
         if step_fn is None:     # offload path: profile the grad function
             if self._offload_grad_fn is None:
-                self._offload_grad_fn = self._build_offload_grad_fn(
-                    bucketed=self._offload_overlap)
+                self._offload_grad_fn = self.telemetry.instrument_step_fn(
+                    "offload_grad_step",
+                    self._build_offload_grad_fn(
+                        bucketed=self._offload_overlap))
+            grad_fn = getattr(self._offload_grad_fn, "__wrapped__",
+                              self._offload_grad_fn)
             res = profile_fn(
-                self._offload_grad_fn, self.state.params, micro_batches,
+                grad_fn, self.state.params, micro_batches,
                 self._base_rng, jnp.asarray(self.global_steps, jnp.int32),
                 jnp.asarray(self._offload.loss_scale, jnp.float32),
                 params=self.state.params, run=False)
@@ -1905,7 +2047,9 @@ class DeepSpeedEngine:
         """Log at steps_per_print boundaries ONLY — any device_get here is a
         host↔device sync that would stall the async dispatch pipeline (the
         TPU analogue of the reference keeping cuda.synchronize behind
-        wall_clock_breakdown). skipped_steps syncs lazily from state."""
+        wall_clock_breakdown). skipped_steps syncs lazily from state. The
+        telemetry drain rides the same boundary discipline (its own
+        report_steps cadence, defaulting to steps_per_print)."""
         if self.global_steps % max(1, self.steps_per_print()) == 0:
             m = {k: (float(jax.device_get(v)) if hasattr(v, "dtype") else v)
                  for k, v in metrics.items()}
@@ -1913,14 +2057,29 @@ class DeepSpeedEngine:
                 # Sentinel: norm computation skipped (no clipping, no fp16) —
                 # don't surface a bogus value to logs/monitors.
                 m.pop("grad_norm", None)
-            self.skipped_steps = int(jax.device_get(self.state.skipped_steps))
+            if self._offload is None:
+                self.skipped_steps = int(
+                    jax.device_get(self.state.skipped_steps))
             gn = f"grad_norm={m['grad_norm']:.4f} " if "grad_norm" in m else ""
+            off = ""
+            if self._offload is not None and self.offload_timings:
+                # The offload breakdown used to die as an undocumented
+                # engine attribute; surface it where the operator looks.
+                t = self.offload_timings
+                host_ms = t.get("host_norm_ms", 0.0) + \
+                    t.get("host_step_ms", 0.0)
+                off = (f" offload[d2h={t.get('d2h_ms', 0.0):.0f}ms "
+                       f"host={host_ms:.0f}ms "
+                       f"h2d={t.get('h2d_dispatch_ms', 0.0):.0f}ms "
+                       f"overlap={t.get('overlap_fraction', 0.0):.2f}]")
             log_dist(
                 f"step={self.global_steps} loss={m['loss']:.6f} "
                 f"lr={m['lr']:.3e} {gn}"
-                f"loss_scale={m['loss_scale']:.1f} overflow={bool(m['overflow'])}",
+                f"loss_scale={m['loss_scale']:.1f} "
+                f"overflow={bool(m['overflow'])}{off}",
                 ranks=[0])
-            self._monitor.write(self.global_steps, m)
+        self.telemetry.maybe_drain(self.global_steps,
+                                   extra_fn=self._report_extra)
 
     # ------------------------------------------------------------------ #
     # torch-style compatibility trio (forward → backward → step)
@@ -1936,13 +2095,18 @@ class DeepSpeedEngine:
                 "forward/backward/step split cannot drive")
         if self._grad_step_fn is None:
             self._build_grad_paths()
+        if getattr(self, "_trio_t0", None) is None:
+            # Start of an accumulation window: step()'s telemetry wall_ms
+            # must cover forward+backward+apply, not just the apply.
+            self._trio_t0 = time.perf_counter()
         theta = jnp.asarray(
             self.progressive_layer_drop.theta_at(self.global_steps),
             jnp.float32) if self._accepts_pld else None
-        grads, raw_loss = self._grad_step_fn(
-            self.state.cast_params if self._use_cast_cache
-            else self.state.params,
-            batch, self._next_rng(), self.state.loss_scale, theta)
+        with self.telemetry.span("grad_compute"):
+            grads, raw_loss = self._grad_step_fn(
+                self.state.cast_params if self._use_cast_cache
+                else self.state.params,
+                batch, self._next_rng(), self.state.loss_scale, theta)
         self._stashed_grads = grads
         return raw_loss
 
@@ -1965,10 +2129,18 @@ class DeepSpeedEngine:
         if self.micro_steps % self.gradient_accumulation_steps() != 0:
             return  # not at boundary; parity with reference gating
         assert self._accum_grads is not None, "no gradients accumulated"
-        self.state, metrics = self._apply_grads_fn(self.state, self._accum_grads)
+        t_apply = time.perf_counter()
+        # Window wall from the first forward() of this accumulation cycle
+        # (fallback: apply-only, when step() is driven without forward).
+        t0 = getattr(self, "_trio_t0", None) or t_apply
+        self._trio_t0 = None
+        with self.telemetry.span("optimizer_apply"):
+            self.state, metrics = self._apply_grads_fn(self.state,
+                                                       self._accum_grads)
         self._accum_grads = None
         self.global_steps += 1
         self.global_samples += self.train_batch_size()
+        self._record_telemetry(metrics, t0, t_apply)
         self._maybe_log(metrics)
 
     def _build_grad_paths(self):
@@ -2038,8 +2210,10 @@ class DeepSpeedEngine:
         def raw_metric_placeholder():
             return jnp.asarray(0.0, jnp.float32)
 
-        self._grad_step_fn = grad_step
-        self._apply_grads_fn = jax.jit(apply_grads, donate_argnums=(0,))
+        self._grad_step_fn = self.telemetry.instrument_step_fn(
+            "grad_step", grad_step)
+        self._apply_grads_fn = self.telemetry.instrument_step_fn(
+            "apply_grads", jax.jit(apply_grads, donate_argnums=(0,)))
         return self._grad_step_fn
 
     # ------------------------------------------------------------------ #
@@ -2051,6 +2225,15 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
                         save_latest: bool = True) -> bool:
+        """Telemetry-spanned entry; see ``_save_checkpoint``."""
+        with self.telemetry.span("checkpoint_save",
+                                 tag=str(tag) if tag is not None else "auto"):
+            return self._save_checkpoint(save_dir, tag, client_state,
+                                         save_latest)
+
+    def _save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                         client_state: Optional[Dict[str, Any]] = None,
+                         save_latest: bool = True) -> bool:
         """Save under ``save_dir/tag/`` with the reference's sharded layout
         (engine.py:1472-1572, §3.5):
 
@@ -2189,6 +2372,16 @@ class DeepSpeedEngine:
                         load_module_strict: bool = True,
                         load_optimizer_states: bool = True,
                         load_lr_scheduler_states: bool = True):
+        """Telemetry-spanned entry; see ``_load_checkpoint``."""
+        with self.telemetry.span("checkpoint_load", dir=str(load_dir)):
+            return self._load_checkpoint(load_dir, tag, load_module_strict,
+                                         load_optimizer_states,
+                                         load_lr_scheduler_states)
+
+    def _load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                         load_module_strict: bool = True,
+                         load_optimizer_states: bool = True,
+                         load_lr_scheduler_states: bool = True):
         if tag is None:
             latest = os.path.join(load_dir, LATEST_FILE)
             if not os.path.isfile(latest):
@@ -2388,37 +2581,8 @@ class DeepSpeedEngine:
             logger.warning(msg)
 
 
-class _Monitor:
-    """Scalar event sink: JSONL always; tensorboard if importable.
-
-    Parity with the engine's tensorboardX hooks (engine.py:247-272)."""
-
-    def __init__(self, config: DeepSpeedConfig):
-        self.enabled = config.tensorboard_config.enabled
-        self.writer = None
-        self.jsonl = None
-        if not self.enabled:
-            return
-        out = config.tensorboard_config.output_path or "./runs"
-        os.makedirs(out, exist_ok=True)
-        self.jsonl = open(os.path.join(
-            out, f"{config.tensorboard_config.job_name}.jsonl"), "a")
-        try:
-            from torch.utils.tensorboard import SummaryWriter
-            self.writer = SummaryWriter(
-                log_dir=os.path.join(out, config.tensorboard_config.job_name))
-        except Exception:
-            self.writer = None
-
-    def write(self, step: int, metrics: Dict[str, Any]) -> None:
-        if not self.enabled:
-            return
-        rec = {"step": step, "ts": time.time(), **{
-            k: (float(v) if isinstance(v, (int, float, np.floating)) else v)
-            for k, v in metrics.items()}}
-        self.jsonl.write(json.dumps(rec) + "\n")
-        self.jsonl.flush()
-        if self.writer is not None:
-            for k, v in metrics.items():
-                if isinstance(v, (int, float, np.floating)):
-                    self.writer.add_scalar(f"Train/{k}", v, step)
+# The engine's old private ``_Monitor`` (tensorboard-gated JSONL sink that
+# every process appended to and never closed) is subsumed by the telemetry
+# subsystem: ``monitor/telemetry.py::JsonlSink`` is the process-0-guarded,
+# close()/atexit-managed successor, and the ``tensorboard`` config block
+# is an alias for a telemetry sink (runtime/config.py::TelemetryConfig).

@@ -102,6 +102,11 @@ class PipelineEngine(DeepSpeedEngine):
             log_dist(f"PipelineEngine: compiled SPMD pipeline pp={pp}, "
                      f"micro_batches={m}, layers={model.num_layers}, "
                      f"schedule={sched}", ranks=[0])
+            # Telemetry provenance: record the pipeline shape in the run's
+            # meta record so TELEMETRY.json can attribute step times.
+            self.telemetry.meta.update(pipeline={
+                "schedule": sched, "stages": pp, "micro_batches": m,
+                "layers": model.num_layers})
             return
 
         assert isinstance(model, PipelineModule)
@@ -242,16 +247,18 @@ class PipelineEngine(DeepSpeedEngine):
             return super()._save_model_states(path, meta)
         host = jax.device_get(self.state.params)
         layer_files = {}
-        for i in range(len(self.pipeline_module.layers)):
-            key = self.pipeline_module.param_key(i)
-            if key in layer_files:
-                continue        # tied params: first owner writes the file
-            fname = self.LAYER_FILE_FMT.format(i)
-            layer_files[key] = fname
-            blob = jax.tree_util.tree_map(np.asarray, host.get(key, {}))
-            if jax.process_index() == 0:
-                with open(os.path.join(path, fname), "wb") as f:
-                    f.write(serialization.to_bytes(blob))
+        with self.telemetry.span("checkpoint_save",
+                                 what="pipeline_layer_states"):
+            for i in range(len(self.pipeline_module.layers)):
+                key = self.pipeline_module.param_key(i)
+                if key in layer_files:
+                    continue    # tied params: first owner writes the file
+                fname = self.LAYER_FILE_FMT.format(i)
+                layer_files[key] = fname
+                blob = jax.tree_util.tree_map(np.asarray, host.get(key, {}))
+                if jax.process_index() == 0:
+                    with open(os.path.join(path, fname), "wb") as f:
+                        f.write(serialization.to_bytes(blob))
         meta["pipeline_layer_files"] = layer_files
 
     def _load_pipeline_layer_states(self, path, meta, params_target):
@@ -259,12 +266,14 @@ class PipelineEngine(DeepSpeedEngine):
         from flax import serialization
         layer_files = meta["pipeline_layer_files"]
         out = dict(params_target)
-        for key, fname in layer_files.items():
-            fp = os.path.join(path, fname)
-            if not os.path.isfile(fp):
-                logger.warning(f"pipeline layer checkpoint {fp} missing")
-                return None
-            with open(fp, "rb") as f:
-                out[key] = serialization.from_bytes(params_target[key],
-                                                    f.read())
+        with self.telemetry.span("checkpoint_load",
+                                 what="pipeline_layer_states"):
+            for key, fname in layer_files.items():
+                fp = os.path.join(path, fname)
+                if not os.path.isfile(fp):
+                    logger.warning(f"pipeline layer checkpoint {fp} missing")
+                    return None
+                with open(fp, "rb") as f:
+                    out[key] = serialization.from_bytes(params_target[key],
+                                                        f.read())
         return out

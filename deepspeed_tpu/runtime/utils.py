@@ -230,17 +230,26 @@ class PartitionedTensor:
 # Memory reporting
 # --------------------------------------------------------------------- #
 def see_memory_usage(message: str, force: bool = False) -> None:
-    """Log device memory stats (parity with utils.py:525-537)."""
+    """Log device memory stats (parity with utils.py:525-537) across ALL
+    local devices — max and sum per field, via the same sampler the
+    telemetry memory watermarks use (monitor/memory.py). Sampling only
+    device 0 hid per-chip imbalance (a sharding bug inflates one chip
+    while device 0 looks fine)."""
     from ..utils.logging import logger
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        in_use = stats.get("bytes_in_use", 0) / 2**30
-        peak = stats.get("peak_bytes_in_use", 0) / 2**30
-        limit = stats.get("bytes_limit", 0) / 2**30
-        logger.info(f"{message} | device mem: in_use={in_use:.2f}GB "
-                    f"peak={peak:.2f}GB limit={limit:.2f}GB")
-    except Exception:
-        logger.info(f"{message} | device memory stats unavailable on this backend")
+    from ..monitor.memory import device_memory_stats
+    stats = device_memory_stats()
+    if stats is None:
+        logger.info(f"{message} | device memory stats unavailable on this "
+                    "backend")
+        return
+    gib = 2 ** 30
+    logger.info(
+        f"{message} | device mem ({stats['num_devices']} device(s)): "
+        f"in_use max={stats['bytes_in_use_max'] / gib:.2f}GB "
+        f"sum={stats['bytes_in_use_sum'] / gib:.2f}GB | "
+        f"peak max={stats['peak_bytes_in_use_max'] / gib:.2f}GB "
+        f"sum={stats['peak_bytes_in_use_sum'] / gib:.2f}GB | "
+        f"limit max={stats['bytes_limit_max'] / gib:.2f}GB")
 
 
 def call_to_str(base: str, *args, **kwargs) -> str:

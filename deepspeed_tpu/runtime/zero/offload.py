@@ -454,6 +454,13 @@ def run_bucketed_step(off: ZeroOffloadOptimizer,
     pb: Dict[str, List[float]] = {
         "d2h_ms": [0.0] * nb, "norm_ms": [0.0] * nb,
         "adam_ms": [0.0] * nb, "h2d_ms": [0.0] * nb}
+    # Per-bucket phase START offsets (seconds since t_start), kept OUTSIDE
+    # ``pb`` so work_ms stays a pure duration sum. With ``t_origin`` they
+    # let telemetry synthesize Chrome-trace spans from these already-fenced
+    # measurements instead of adding fences of its own.
+    t0s: Dict[str, List[float]] = {
+        "d2h_t0": [0.0] * nb, "norm_t0": [0.0] * nb,
+        "adam_t0": [0.0] * nb, "h2d_t0": [0.0] * nb}
     parts = [0.0] * nb
     repls = [0.0] * nb
     host_grads: List[Optional[list]] = [None] * nb
@@ -461,16 +468,19 @@ def run_bucketed_step(off: ZeroOffloadOptimizer,
 
     def fetch(b: int) -> None:
         t0 = time.perf_counter()
+        t0s["d2h_t0"][b] = t0 - t_start
         host_grads[b] = fetch_bucket(b)
         pb["d2h_ms"][b] = (time.perf_counter() - t0) * 1e3
 
     def norm(b: int) -> None:
         t0 = time.perf_counter()
+        t0s["norm_t0"][b] = t0 - t_start
         parts[b], repls[b] = off.bucket_sumsq(b, host_grads[b])
         pb["norm_ms"][b] = (time.perf_counter() - t0) * 1e3
 
     def adam(b: int, lr: float, coeff: float) -> Optional[list]:
         t0 = time.perf_counter()
+        t0s["adam_t0"][b] = t0 - t_start
         out = off.bucket_apply(b, host_grads[b], lr, coeff,
                                want_upload=upload_bucket is not None)
         pb["adam_ms"][b] = (time.perf_counter() - t0) * 1e3
@@ -480,6 +490,7 @@ def run_bucketed_step(off: ZeroOffloadOptimizer,
         if upload_bucket is None:
             return
         t0 = time.perf_counter()
+        t0s["h2d_t0"][b] = t0 - t_start
         upload_bucket(b, leaves)
         pb["h2d_ms"][b] = (time.perf_counter() - t0) * 1e3
 
@@ -512,6 +523,8 @@ def run_bucketed_step(off: ZeroOffloadOptimizer,
     work_ms = sum(sum(v) for v in pb.values())
     timings = {
         "per_bucket": pb,
+        "per_bucket_t0": t0s,
+        "t_origin": t_start,
         "d2h_ms": sum(pb["d2h_ms"]),
         "host_norm_ms": sum(pb["norm_ms"]),
         "host_step_ms": sum(pb["adam_ms"]),

@@ -16,8 +16,21 @@ from typing import Dict, List, Optional
 from .logging import logger
 
 
+# Instrumented fence counter: every _device_sync() is a full host↔device
+# round trip, so tier-1 can ASSERT the no-added-hot-path-fences telemetry
+# design rule instead of trusting it (tests/test_telemetry.py).
+_SYNC_COUNT = 0
+
+
+def device_sync_count() -> int:
+    """Total _device_sync() fences issued by this process."""
+    return _SYNC_COUNT
+
+
 def _device_sync() -> None:
     """Block until all dispatched device work is complete."""
+    global _SYNC_COUNT
+    _SYNC_COUNT += 1
     try:
         import jax
         (jax.device_put(0.0) + 0).block_until_ready()
@@ -182,9 +195,16 @@ class ThroughputTimer:
                 f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.4f}, "
                 f"CurrSamplesPerSec={self.batch_size * self.num_workers / max(step_duration, 1e-12):.4f}")
 
+    def has_samples(self) -> bool:
+        """True once at least one measurement window has closed — the
+        explicit no-data signal (``avg_samples_per_sec`` returns 0.0
+        before then; it used to return ``float("-1")``, a sentinel that
+        read as a plausible-but-absurd rate downstream)."""
+        return self.counted_steps > 0 and self.total_elapsed_time > 0
+
     def avg_samples_per_sec(self) -> float:
-        if self.counted_steps > 0 and self.total_elapsed_time > 0:
+        if self.has_samples():
             samples_per_step = self.batch_size * self.num_workers
             avg_time_per_step = self.total_elapsed_time / self.counted_steps
             return samples_per_step / max(avg_time_per_step, 1e-12)
-        return float("-1")
+        return 0.0

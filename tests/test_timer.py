@@ -1,0 +1,113 @@
+"""utils/timer.py coverage: SynchronizedWallClockTimer mid-run elapsed()
+count restoration, ThroughputTimer windowed (non-synchronized) mode, and
+the no-samples signal (0.0 + has_samples(), replacing the old
+``float("-1")`` sentinel)."""
+import time
+
+import deepspeed_tpu.utils.timer as timer_mod
+from deepspeed_tpu.utils.timer import SynchronizedWallClockTimer, \
+    ThroughputTimer
+
+
+class TestSynchronizedWallClockTimer:
+    def test_basic_cycle(self):
+        t = SynchronizedWallClockTimer.Timer("t")
+        t.start(synchronize=False)
+        time.sleep(0.002)
+        t.stop(synchronize=False)
+        assert t.count == 1
+        assert t.elapsed_ > 0
+        assert t.mean() == t.elapsed_
+
+    def test_mid_run_elapsed_restores_count(self):
+        """elapsed() while running must not inflate count: mean() should
+        reflect only real start/stop cycles."""
+        t = SynchronizedWallClockTimer.Timer("t")
+        t.start(synchronize=False)
+        t.stop(synchronize=False)
+        first = t.elapsed_
+        t.start(synchronize=False)
+        time.sleep(0.002)
+        mid = t.elapsed(reset=False)     # query mid-run
+        assert mid >= first              # includes the running interval
+        assert t.started_                # still running afterwards
+        assert t.count == 1              # the mid-run stop didn't count
+        t.stop(synchronize=False)
+        assert t.count == 2
+        assert t.mean() == t.elapsed_ / 2
+
+    def test_mid_run_elapsed_with_reset(self):
+        t = SynchronizedWallClockTimer.Timer("t")
+        t.start(synchronize=False)
+        time.sleep(0.001)
+        val = t.elapsed(reset=True)
+        assert val > 0
+        assert t.started_           # restarted after the reset
+        assert t.count == 0         # reset cleared it; restore kept 0
+        t.stop(synchronize=False)
+        assert t.count == 1
+
+    def test_group_log(self):
+        timers = SynchronizedWallClockTimer()
+        timers("a").start(synchronize=False)
+        timers("a").stop(synchronize=False)
+        out = timers.log(["a", "missing"], reset=True)
+        assert "a:" in out and "missing" not in out
+
+
+class TestThroughputTimer:
+    def _spin(self, t, n, sleep=0.001):
+        for _ in range(n):
+            t.start()
+            time.sleep(sleep)
+            t.stop(report_speed=False)
+
+    def test_no_samples_signal(self):
+        """Before any measurement window closes, the timer reports 0.0
+        with an explicit has_samples() == False — NOT the old
+        float("-1") sentinel that read as a plausible rate."""
+        t = ThroughputTimer(batch_size=8, start_step=2, steps_per_output=4,
+                            synchronized=False)
+        assert not t.has_samples()
+        assert t.avg_samples_per_sec() == 0.0
+        self._spin(t, 3)        # warmup only; window not closed yet
+        assert not t.has_samples()
+        assert t.avg_samples_per_sec() == 0.0
+
+    def test_windowed_mode_measures(self):
+        """Non-synchronized mode fences only at window boundaries and
+        averages over the window."""
+        t = ThroughputTimer(batch_size=8, start_step=2, steps_per_output=4,
+                            synchronized=False)
+        self._spin(t, 12)
+        assert t.has_samples()
+        rate = t.avg_samples_per_sec()
+        assert rate > 0
+        # Sanity bound: each counted step slept >= 1 ms, so the rate
+        # cannot exceed batch_size / 1ms.
+        assert rate < 8 / 0.001 * 1.5
+        # Window accounting: counted steps cover only closed windows.
+        assert t.counted_steps > 0
+        assert t.total_elapsed_time > 0
+
+    def test_windowed_syncs_only_at_boundaries(self):
+        t = ThroughputTimer(batch_size=8, start_step=0, steps_per_output=5,
+                            synchronized=False)
+        before = timer_mod.device_sync_count()
+        self._spin(t, 5)
+        # one fence to open the window + one to close it
+        assert timer_mod.device_sync_count() - before == 2
+
+    def test_synchronized_mode_fences_every_step(self):
+        t = ThroughputTimer(batch_size=8, start_step=0, steps_per_output=100,
+                            synchronized=True)
+        before = timer_mod.device_sync_count()
+        self._spin(t, 3)
+        assert timer_mod.device_sync_count() - before == 2 * 3
+        assert t.has_samples()
+
+
+def test_device_sync_counter_increments():
+    before = timer_mod.device_sync_count()
+    timer_mod._device_sync()
+    assert timer_mod.device_sync_count() == before + 1

@@ -1,0 +1,178 @@
+#!/usr/bin/env python
+"""Summarize a telemetry JSONL run into TELEMETRY.json.
+
+Usage:
+    python tools/telemetry_report.py runs/MyJob.jsonl [-o TELEMETRY.json]
+
+Reads the line records the monitor/ subsystem emits (kind: meta | step |
+report | event) and produces one machine-diffable summary so benches and
+CI can compare runs:
+
+- step time p50/p95/mean (ms) — per-step host wall. On the jitted paths
+  this is DISPATCH wall (steps pipeline asynchronously); the fenced
+  ground truth is ``throughput.samples_per_sec`` from the report
+  record's synchronized window average.
+- throughput (samples/sec, window-averaged) and total samples.
+- recompile count + the offending functions/signature deltas.
+- peak device memory vs the analytic ZeRO model-state footprint (and any
+  watermark events). ``memory.available: false`` when the backend
+  reports no ``memory_stats()`` (e.g. CPU).
+- wire bytes/step from the grad-sync wire model, with a consistency
+  check between the meta record and the per-step records.
+- overflow/skipped-step counts and dropped-record accounting (a ring
+  overflow between drains is reported, never silent).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (no numpy dep so
+    the tool runs anywhere)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[k])
+
+
+def summarize(jsonl_path: str) -> Dict[str, Any]:
+    """Summary of the LATEST run in the stream: the sink appends (so a
+    resumed/re-launched job with the same job_name extends one file), and
+    every run opens with a ``meta`` record — seeing one resets the
+    accumulators so earlier runs' steps can't contaminate this run's
+    percentiles, recompile counts, or consistency checks."""
+    meta: Dict[str, Any] = {}
+    steps: List[Dict[str, Any]] = []
+    reports: List[Dict[str, Any]] = []
+    events: List[Dict[str, Any]] = []
+    with open(jsonl_path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            kind = rec.get("kind")
+            if kind == "meta":
+                meta, steps, reports, events = dict(rec), [], [], []
+            elif kind == "step":
+                steps.append(rec)
+            elif kind == "report":
+                reports.append(rec)
+            elif kind == "event":
+                events.append(rec)
+
+    walls = sorted(float(r["wall_ms"]) for r in steps if "wall_ms" in r)
+    recompiles = [e for e in events if e.get("event") == "recompile"]
+    watermarks = [e for e in events if e.get("event") == "memory_watermark"]
+
+    # Throughput: the last report with a closed (valid) window wins.
+    samples_per_sec: Optional[float] = None
+    for rep in reversed(reports):
+        if rep.get("samples_per_sec_valid"):
+            samples_per_sec = float(rep["samples_per_sec"])
+            break
+
+    # Wire bytes: meta is authoritative; per-step records must agree.
+    wire_meta = meta.get("wire_bytes_per_step")
+    step_wires = {int(r["wire_bytes"]) for r in steps if "wire_bytes" in r}
+    wire_consistent = (wire_meta is None and not step_wires) or \
+        (wire_meta is not None and
+         (not step_wires or step_wires == {int(wire_meta)}))
+
+    # Memory: peak across every drain sample vs the analytic footprint.
+    peaks = [int(rep["memory"]["peak_bytes_in_use_max"]) for rep in reports
+             if isinstance(rep.get("memory"), dict)
+             and "peak_bytes_in_use_max" in rep["memory"]]
+    analytic = meta.get("analytic_state_bytes")
+    memory: Dict[str, Any] = {"available": bool(peaks)}
+    if analytic is not None:
+        memory["analytic_state_bytes"] = int(analytic)
+    if peaks:
+        memory["peak_bytes_in_use_max"] = max(peaks)
+        if analytic:
+            memory["peak_vs_analytic_ratio"] = round(
+                max(peaks) / max(1, int(analytic)), 4)
+    memory["watermark_events"] = len(watermarks)
+
+    overflows = sum(1 for r in steps if r.get("overflow"))
+    skipped = None
+    for rep in reversed(reports):
+        if "skipped_steps" in rep:
+            skipped = int(rep["skipped_steps"])
+            break
+
+    offload_steps = [r["offload"] for r in steps
+                     if isinstance(r.get("offload"), dict)]
+    offload: Optional[Dict[str, Any]] = None
+    if offload_steps:
+        fracs = [float(o.get("overlap_fraction", 0.0))
+                 for o in offload_steps]
+        offload = {
+            "steps": len(offload_steps),
+            "overlap_fraction_mean": round(sum(fracs) / len(fracs), 4),
+            "num_buckets": offload_steps[-1].get("num_buckets"),
+            "overlapped": offload_steps[-1].get("overlapped"),
+        }
+
+    return {
+        "source": os.path.basename(jsonl_path),
+        "meta": {k: v for k, v in meta.items() if k not in ("kind", "ts")},
+        "steps_recorded": len(steps),
+        "dropped_records": sum(int(rep.get("dropped_records", 0))
+                               for rep in reports),
+        "step_time_ms": {
+            "p50": round(_percentile(walls, 50), 3),
+            "p95": round(_percentile(walls, 95), 3),
+            "mean": round(sum(walls) / len(walls), 3) if walls else 0.0,
+            "n": len(walls),
+            "note": "host wall per train_batch: dispatch wall on jitted "
+                    "paths, true wall on the host-synchronous offload path",
+        },
+        "throughput": {
+            "samples_per_sec": samples_per_sec,
+            "window_valid": samples_per_sec is not None,
+        },
+        "recompiles": {
+            "count": len(recompiles),
+            "events": [{"fn": e.get("fn"),
+                        "step": e.get("step"),
+                        "signature_delta": e.get("signature_delta")}
+                       for e in recompiles],
+        },
+        "memory": memory,
+        "wire_bytes_per_step": wire_meta,
+        "wire_bytes_consistent": wire_consistent,
+        "overflow_steps": overflows,
+        "skipped_steps": skipped,
+        "offload": offload,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("jsonl", help="telemetry JSONL stream to summarize")
+    ap.add_argument("-o", "--output", default="TELEMETRY.json",
+                    help="summary output path (default TELEMETRY.json)")
+    args = ap.parse_args(argv)
+    summary = summarize(args.jsonl)
+    with open(args.output, "w") as f:
+        json.dump(summary, f, indent=2)
+    st = summary["step_time_ms"]
+    print(f"{args.output}: {summary['steps_recorded']} steps, "
+          f"p50={st['p50']}ms p95={st['p95']}ms, "
+          f"recompiles={summary['recompiles']['count']}, "
+          f"watermarks={summary['memory']['watermark_events']}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
