@@ -49,20 +49,12 @@ def pick_model():
         GPT2_CONFIGS["gpt2-tiny"], hidden_dropout=0.0, attn_dropout=0.0), 4
 
 
-# Rough bf16 peak TFLOPs per chip by TPU generation (public figures);
-# used only for the utilisation denominator.
-TPU_PEAK_TFLOPS = {
-    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
-}
-
-
-def chip_peak_tflops() -> float:
-    d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "").lower()
-    for key, peak in TPU_PEAK_TFLOPS.items():
-        if key in kind:
-            return peak
-    return 197.0  # default to v5e if unknown TPU; CPU runs report vs this too
+# The chip peak table lives in monitor/peaks.py now — ONE source of
+# truth shared with the roofline cost model, env_report, and the bench
+# gate. Re-exported here for the historical bench API (unknown kinds,
+# incl. CPU dev runs, report vs an assumed v5e peak as before).
+from deepspeed_tpu.monitor.peaks import (TPU_PEAK_TFLOPS,   # noqa: F401
+                                         chip_peak_tflops)
 
 
 def bench_offload_xl(gas: int = 1, n_steps: int = 2,
@@ -412,6 +404,10 @@ def main():
         "unit": f"TFLOPs/chip (bf16, {n_chips} chip(s), "
                 f"{tokens_per_sec:,.0f} tok/s, {frac_peak:.1%} of peak)",
         "vs_baseline": round(frac_peak / ref_frac, 3),
+        # Model-FLOPs utilisation against the shared monitor/peaks.py
+        # table (true MFU: analytic model flops/token, remat recompute
+        # excluded). tools/bench_gate.py diffs this field across rounds.
+        "mfu": round(frac_peak, 4),
         # Ladder provenance: which optimizer apply produced this number.
         "fused_optimizer_apply": ds_config["optimizer"]["params"]["fused"],
     }

@@ -199,6 +199,14 @@ TELEMETRY_PROFILE_NUM_STEPS = "profile_num_steps"
 TELEMETRY_PROFILE_NUM_STEPS_DEFAULT = 1
 TELEMETRY_PROFILE_DIR = "profile_dir"
 TELEMETRY_PROFILE_DIR_DEFAULT = ""
+# Roofline cost model: at the FIRST report boundary, AOT-relower every
+# compiled step path from its recorded abstract signature, pull XLA's
+# cost_analysis() (flops + bytes accessed), fuse it with the jaxpr-walk
+# analytic flops and the grad-sync wire model, and emit per-path
+# compute/HBM/interconnect-bound verdicts + per-step MFU (one-time
+# host-side compile at the boundary; no device traffic, no fences).
+TELEMETRY_COST_MODEL = "cost_model"
+TELEMETRY_COST_MODEL_DEFAULT = True
 
 #############################################
 # ZeRO

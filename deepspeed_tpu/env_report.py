@@ -56,6 +56,7 @@ def device_report(lines=None) -> list:
     out.append("-" * 64)
     try:
         import jax
+        from .monitor.peaks import peaks_for_kind
         devs = jax.devices()
         out.append(f"platform ............... {devs[0].platform}")
         out.append(f"devices (global) ....... {jax.device_count()}")
@@ -63,7 +64,14 @@ def device_report(lines=None) -> list:
         out.append(f"process count .......... {jax.process_count()}")
         for d in devs[: min(8, len(devs))]:
             kind = getattr(d, "device_kind", "?")
-            out.append(f"  device {d.id}: {kind}")
+            # Per-chip ceilings from the shared peak table (the MFU /
+            # roofline denominators — monitor/peaks.py).
+            pk = peaks_for_kind(kind)
+            peak = (f"no peak-table entry; roofline assumes {pk.name}"
+                    if pk.assumed else
+                    f"peak {pk.bf16_tflops:.0f} bf16 TFLOPs, "
+                    f"{pk.hbm_gbs:.0f} GB/s HBM, {pk.ici_gbs:.0f} GB/s ICI")
+            out.append(f"  device {d.id}: {kind} ({peak})")
         try:
             stats = devs[0].memory_stats()
             if stats and "bytes_limit" in stats:

@@ -3,12 +3,22 @@
 First-class operational visibility for TPU training runs: structured
 per-step records (ring-buffered, drained to JSONL at report boundaries
 with zero added hot-path syncs), host-side Chrome-trace spans, a
-recompile sentinel over the engine's compiled step functions, and
+recompile sentinel over the engine's compiled step functions,
 device-memory watermarks checked against the analytic ZeRO-partitioned
-model-state footprint. See docs/tutorials/telemetry.md.
+model-state footprint, a roofline cost model fusing XLA's compiled cost
+analysis with the jaxpr-walk flops profiler and the interconnect wire
+model (per-path compute/HBM/interconnect-bound verdicts + per-step MFU),
+and a goodput ledger attributing every wall-clock second between report
+boundaries. See docs/tutorials/telemetry.md.
 """
+from .cost_model import (BOUND_COMPUTE, BOUND_HBM, BOUND_INTERCONNECT,
+                         build_cost_model, mfu, roofline)
+from .goodput import BUCKETS as GOODPUT_BUCKETS
+from .goodput import GoodputLedger
 from .memory import (MemoryWatermark, analytic_state_bytes,
                      device_memory_stats)
+from .peaks import (TPU_PEAK_TFLOPS, ChipPeaks, chip_peak_tflops,
+                    chip_peaks)
 from .recompile import RecompileError, RecompileSentinel
 from .telemetry import JsonlSink, Telemetry
 from .trace import ProfilerWindow, TraceWriter
@@ -17,4 +27,8 @@ __all__ = [
     "Telemetry", "JsonlSink", "TraceWriter", "ProfilerWindow",
     "RecompileSentinel", "RecompileError", "MemoryWatermark",
     "analytic_state_bytes", "device_memory_stats",
+    "GoodputLedger", "GOODPUT_BUCKETS",
+    "build_cost_model", "roofline", "mfu",
+    "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT",
+    "ChipPeaks", "chip_peaks", "chip_peak_tflops", "TPU_PEAK_TFLOPS",
 ]

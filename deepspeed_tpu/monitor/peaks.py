@@ -1,0 +1,109 @@
+"""Chip peak table — the ONE place hardware ceilings live.
+
+``bench.py`` used to own a private ``TPU_PEAK_TFLOPS`` dict for its
+utilisation denominator; the roofline cost model (cost_model.py), the
+environment report, and the bench gate all need the same numbers, so the
+table lives here and everyone imports it.
+
+The figures are rough public per-chip specs by TPU generation:
+
+- ``bf16_tflops``: dense bf16/int8-class matmul peak (the MXU ceiling and
+  the MFU denominator);
+- ``hbm_gbs``: HBM bandwidth, GB/s (the memory-roofline ceiling);
+- ``ici_gbs``: aggregate inter-chip interconnect bandwidth per chip, GB/s
+  one-way (the communication-roofline ceiling for ring collectives).
+
+They are CEILINGS for roofline verdicts and utilisation fractions, not
+measurements — real programs see lower effective bandwidth (stride
+patterns, link contention). On non-TPU backends (CPU dev meshes) there is
+no meaningful peak; ``chip_peaks()`` returns the v5e row flagged
+``assumed=True`` so downstream math stays total-ordered and every
+consumer can say "vs an ASSUMED v5e peak" instead of crashing or silently
+printing garbage.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+
+# Rough bf16 peak TFLOPs per chip by TPU generation (public figures);
+# the utilisation denominator (lifted from bench.py, now shared).
+TPU_PEAK_TFLOPS: Dict[str, float] = {
+    "v4": 275.0, "v5e": 197.0, "v5p": 459.0, "v6e": 918.0,
+}
+
+# HBM bandwidth GB/s per chip (public figures, same generations).
+TPU_HBM_GBS: Dict[str, float] = {
+    "v4": 1228.0, "v5e": 819.0, "v5p": 2765.0, "v6e": 1640.0,
+}
+
+# Aggregate one-way ICI bandwidth GB/s per chip (public per-chip
+# interconnect figures: 2400/1600/4800/3584 Gbps).
+TPU_ICI_GBS: Dict[str, float] = {
+    "v4": 300.0, "v5e": 200.0, "v5p": 600.0, "v6e": 448.0,
+}
+
+_DEFAULT_GEN = "v5e"
+
+
+@dataclasses.dataclass(frozen=True)
+class ChipPeaks:
+    """Per-chip hardware ceilings for one device generation."""
+    name: str                  # resolved generation key, e.g. "v5e"
+    bf16_tflops: float
+    hbm_gbs: float
+    ici_gbs: float
+    assumed: bool = False      # True when the device kind had no table row
+
+    @property
+    def flops_per_sec(self) -> float:
+        return self.bf16_tflops * 1e12
+
+    @property
+    def hbm_bytes_per_sec(self) -> float:
+        return self.hbm_gbs * 1e9
+
+    @property
+    def ici_bytes_per_sec(self) -> float:
+        return self.ici_gbs * 1e9
+
+    def as_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+def _resolve_gen(device_kind: str) -> Optional[str]:
+    kind = (device_kind or "").lower()
+    for key in TPU_PEAK_TFLOPS:
+        if key in kind:
+            return key
+    return None
+
+
+def peaks_for_kind(device_kind: str) -> ChipPeaks:
+    """ChipPeaks for a device-kind string; unknown kinds (CPU, GPU, future
+    TPUs) get the v5e row flagged ``assumed``."""
+    gen = _resolve_gen(device_kind)
+    key, assumed = (gen, False) if gen else (_DEFAULT_GEN, True)
+    return ChipPeaks(name=key, bf16_tflops=TPU_PEAK_TFLOPS[key],
+                     hbm_gbs=TPU_HBM_GBS[key], ici_gbs=TPU_ICI_GBS[key],
+                     assumed=assumed)
+
+
+def chip_peaks(device=None) -> ChipPeaks:
+    """ChipPeaks of ``device`` (default: the first visible device)."""
+    if device is None:
+        import jax
+        device = jax.devices()[0]
+    return peaks_for_kind(getattr(device, "device_kind", ""))
+
+
+def chip_peak_tflops() -> float:
+    """bf16 peak TFLOPs of the first visible chip (bench.py's historical
+    API: defaults to v5e when the kind is unknown; CPU runs report vs
+    that assumed peak too)."""
+    return chip_peaks().bf16_tflops
+
+
+__all__ = ["TPU_PEAK_TFLOPS", "TPU_HBM_GBS", "TPU_ICI_GBS", "ChipPeaks",
+           "peaks_for_kind", "chip_peaks", "chip_peak_tflops"]

@@ -19,6 +19,7 @@ loop says so. The sentinel wraps each jitted step function:
 from __future__ import annotations
 
 import functools
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
@@ -81,6 +82,11 @@ class RecompileSentinel:
         self.events: List[Dict[str, Any]] = []
         self.pending_error: Optional[RecompileError] = None
         self._fns: Dict[str, Dict[str, Any]] = {}
+        # Cumulative wall of cache-miss calls (trace+compile+dispatch;
+        # the dispatch of a missing call blocks through compilation).
+        # Warmup compiles count too — the goodput ledger attributes ALL
+        # compile wall, cold start included.
+        self.compile_wall_s = 0.0
 
     def raise_pending(self) -> None:
         """Raise (once) a fail_on_recompile violation recorded by the last
@@ -106,7 +112,9 @@ class RecompileSentinel:
         call/donation semantics; the raw function stays reachable via
         ``__wrapped__`` for introspection (flops profiler, hlo audit)."""
         st = self._fns.setdefault(
-            name, {"calls": 0, "compiles": 0, "seen": set(), "descs": None})
+            name, {"calls": 0, "compiles": 0, "seen": set(), "descs": None,
+                   "compile_wall_s": 0.0, "fn": fn, "abstract_args": None})
+        st["fn"] = fn
         cache_size = getattr(fn, "_cache_size", None)
         if not callable(cache_size):
             cache_size = None
@@ -120,6 +128,7 @@ class RecompileSentinel:
             # signature, which is the question the operator is asking.
             # Only the fallback path (no _cache_size) pays the per-call
             # signature, because membership IS its miss detector.
+            t_call0 = time.perf_counter()
             if cache_size is not None:
                 before = cache_size()
                 out = fn(*args, **kwargs)
@@ -134,6 +143,16 @@ class RecompileSentinel:
             prior_calls = st["calls"]
             st["calls"] += 1
             if miss:
+                # Miss-only work: the call just paid seconds of compile,
+                # so clocking it and mirroring the abstract signature
+                # (ShapeDtypeStructs survive buffer donation — the cost
+                # model AOT-relowers from them at report boundaries) is
+                # noise on top.
+                dt = time.perf_counter() - t_call0
+                st["compile_wall_s"] += dt
+                self.compile_wall_s += dt
+                from .cost_model import abstract_args_of
+                st["abstract_args"] = abstract_args_of(args, kwargs)
                 prev_descs, st["descs"] = st["descs"], descs
                 st["compiles"] += 1
                 if prior_calls >= self.warmup_calls:

@@ -122,6 +122,8 @@ class TelemetryConfig:
                                      C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT)
         self.profile_dir = get(d, C.TELEMETRY_PROFILE_DIR,
                                C.TELEMETRY_PROFILE_DIR_DEFAULT)
+        self.cost_model = get(d, C.TELEMETRY_COST_MODEL,
+                              C.TELEMETRY_COST_MODEL_DEFAULT)
         self._validate()
 
     def _validate(self) -> None:
@@ -144,6 +146,10 @@ class TelemetryConfig:
             raise DeepSpeedConfigError(
                 f"{C.TELEMETRY}.{C.TELEMETRY_WATERMARK_RATIO} must be a "
                 f"positive number, got {self.watermark_ratio!r}")
+        if not isinstance(self.cost_model, bool):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_COST_MODEL} must be a bool, "
+                f"got {self.cost_model!r}")
 
 
 class MeshConfig:

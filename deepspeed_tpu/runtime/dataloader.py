@@ -12,17 +12,25 @@ Accepts torch datasets/dataloaders, numpy arrays, or any indexable.
 """
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
 
 
 class RepeatingLoader:
-    """Wrap an iterator to restart on StopIteration (dataloader.py:10)."""
+    """Wrap an iterator to restart on StopIteration (dataloader.py:10).
+
+    Fetch-wait instrumented: ``fetch_wait_s`` accumulates the host wall
+    spent inside ``__next__`` (monotonic clock only — no device access),
+    so the goodput ledger and operators can see data stalls. This
+    wrapper's wait already INCLUDES any wrapped loader's own fetch
+    time."""
 
     def __init__(self, loader: Iterable):
         self.loader = loader
         self.data_iter = iter(self.loader)
+        self.fetch_wait_s = 0.0
 
     def __iter__(self):
         return self
@@ -31,11 +39,18 @@ class RepeatingLoader:
         return len(self.loader)
 
     def __next__(self):
+        t0 = time.perf_counter()
         try:
-            return next(self.data_iter)
-        except StopIteration:
-            self.data_iter = iter(self.loader)
-            return next(self.data_iter)
+            try:
+                return next(self.data_iter)
+            except StopIteration:
+                self.data_iter = iter(self.loader)
+                return next(self.data_iter)
+        finally:
+            self.fetch_wait_s += time.perf_counter() - t0
+
+    def cumulative_fetch_wait_s(self) -> float:
+        return self.fetch_wait_s
 
 
 def default_collate(samples: Sequence[Any]):
@@ -82,6 +97,9 @@ class DeepSpeedDataLoader:
         self.dp_rank = (data_parallel_rank if data_parallel_rank is not None
                         else jax.process_index())
         self.data_sampler = data_sampler
+        # Cumulative host wall spent assembling batches (dataset access +
+        # collate) — the loader-local data-stall counter.
+        self.fetch_wait_s = 0.0
         self._len = self._shard_len() // batch_size if drop_last else \
             -(-self._shard_len() // batch_size)
 
@@ -113,9 +131,15 @@ class DeepSpeedDataLoader:
         usable = (len(shard) // self.batch_size) * self.batch_size \
             if self.drop_last else len(shard)
         for start in range(0, usable, self.batch_size):
+            t0 = time.perf_counter()
             idxs = shard[start:start + self.batch_size]
             samples = [self.dataset[int(i)] for i in idxs]
-            yield self.collate_fn(samples)
+            batch = self.collate_fn(samples)
+            self.fetch_wait_s += time.perf_counter() - t0
+            yield batch
+
+    def cumulative_fetch_wait_s(self) -> float:
+        return self.fetch_wait_s
 
 
 class ArrayDataset:

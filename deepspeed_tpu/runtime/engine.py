@@ -572,6 +572,10 @@ class DeepSpeedEngine:
         # shardings (host metadata only) — the watermark baseline.
         self.telemetry.set_analytic_footprint(
             analytic_state_bytes(self.state))
+        # Roofline cost model: built ONCE at the first report boundary
+        # (every active step path has compiled by then); see
+        # _maybe_build_cost_model.
+        self._cost_model_built = False
 
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
@@ -1886,10 +1890,19 @@ class DeepSpeedEngine:
                     self._data_iterator = iter(RepeatingLoader(self.training_dataloader))
                 it = self._data_iterator
             gas = self.gradient_accumulation_steps()
+            # Fetch-wait accounting for the goodput ledger: host wall the
+            # engine spends waiting on the input pipeline (monotonic clock
+            # only, no device access). Covers any iterator — the
+            # dataloader's own fetch_wait_s counter is the loader-local
+            # view of the same stall.
+            t_fetch0 = time.perf_counter()
             micro = [next(it) for _ in range(gas)]
             batch = jax.tree_util.tree_map(
                 lambda *xs: np.concatenate([np.asarray(x) for x in xs], axis=0),
                 *micro)
+            if tl.ledger is not None:
+                tl.ledger.note("data_stall",
+                               time.perf_counter() - t_fetch0)
 
         if self._offload is None and self.dp_size == 1 \
                 and jax.process_count() == 1:
@@ -1989,6 +2002,7 @@ class DeepSpeedEngine:
     def _report_extra(self) -> Dict[str, Any]:
         """Report-boundary fields for the telemetry drain record. Called
         ONLY at a drain boundary (the skipped_steps read is a sync)."""
+        self._maybe_build_cost_model()
         extra: Dict[str, Any] = {
             "global_samples": self.global_samples,
             "samples_per_sec": self.tput_timer.avg_samples_per_sec(),
@@ -2001,6 +2015,72 @@ class DeepSpeedEngine:
                 jax.device_get(self.state.skipped_steps))
             extra["skipped_steps"] = self.skipped_steps
         return extra
+
+    # ------------------------------------------------------------------ #
+    # Roofline cost model (monitor/cost_model.py)
+    # ------------------------------------------------------------------ #
+    def _maybe_build_cost_model(self) -> None:
+        """Build the roofline cost model ONCE, at the first report
+        boundary — every active step path has compiled by then, and the
+        recompile sentinel holds each one's abstract signature. The build
+        AOT-relowers each path host-side (no device traffic, no fences);
+        any failure degrades to a structured event, never to a dead
+        training loop."""
+        tl = self.telemetry
+        if self._cost_model_built or not tl.enabled \
+                or tl.sentinel is None \
+                or not getattr(self.config.telemetry_config,
+                               "cost_model", True):
+            return
+        self._cost_model_built = True
+        try:
+            from ..monitor.cost_model import build_cost_model
+            step_paths = self._cost_model_step_paths()
+            # Wire bytes are PER STEP; price them on the grad-computing
+            # path, split per invocation so the step total reconciles.
+            comm: Dict[str, float] = {}
+            for p in ("train_step", "offload_grad_step",
+                      "sparse_grad_step", "grad_step"):
+                if p in step_paths and self._wire_bytes:
+                    comm[p] = float(self._wire_bytes) / step_paths[p]
+                    break
+            payload = build_cost_model(
+                tl.sentinel, comm_bytes_by_path=comm,
+                step_paths=step_paths, n_devices=int(self.mesh.size))
+            payload.update(self._cost_model_extras(payload))
+            tl.set_cost_model(payload,
+                              samples_per_step=self.train_batch_size())
+            step = payload.get("step", {})
+            if step.get("bound"):
+                log_dist(
+                    "cost model: step is "
+                    f"{step['bound']}-bound, analytic floor "
+                    f"{step['floor_ms']:.3f} ms/step "
+                    f"({payload['chip']['name']} peaks"
+                    f"{', ASSUMED' if payload['chip']['assumed'] else ''})",
+                    ranks=[0])
+        except Exception as e:   # observability must not kill training
+            tl.event("cost_model_error",
+                     {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    def _cost_model_step_paths(self) -> Dict[str, float]:
+        """{path_name: invocations per optimizer step} for the paths that
+        compose ONE train step in the engine's active mode."""
+        if self._offload is not None:
+            return {"offload_grad_step": 1.0}
+        if self._sparse_mask is not None and self.dp_size > 1:
+            return {"sparse_grad_step": 1.0, "sparse_apply_step": 1.0}
+        if self._train_step_fn is not None:
+            return {"train_step": 1.0}
+        # forward/backward/step trio: one grad program per micro-batch,
+        # one apply at the accumulation boundary.
+        return {"grad_step": float(self.gradient_accumulation_steps()),
+                "apply_grads": 1.0}
+
+    def _cost_model_extras(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Subclass hook for extra cost-model payload sections (the
+        pipeline engine adds per-stage attribution)."""
+        return {}
 
     def eval_batch(self, batch, rng=None):
         if self._eval_step_fn is None:

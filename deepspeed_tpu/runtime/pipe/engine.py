@@ -140,6 +140,41 @@ class PipelineEngine(DeepSpeedEngine):
                 "body) via hetero_pipe_spec (runtime/pipe/hetero.py)")
         log_dist(self.pipeline_module.describe(), ranks=[0])
 
+    def _cost_model_extras(self, payload):
+        """Per-stage attribution for the cost-model payload, via the
+        jaxpr-walk flops profiler (the analytic counter the cost model
+        already ran over the pipelined train step). The compiled SPMD
+        pipeline is symmetric by construction — every stage device runs
+        the same program over num_layers/pp layers — so the per-stage
+        split is uniform and exact, embedding/head work included (SPMD
+        executes those eqns on every stage, stage-masked)."""
+        if self._pipe_spec is None:
+            return {}
+        paths = payload.get("paths") or {}
+        train = paths.get("train_step") or {}
+        flops = train.get("analytic_flops")
+        if not flops:
+            return {}
+        pp = int(self.mesh.shape.get("pipe", 1))
+        per_stage = float(flops) / max(1, pp)
+        section = {
+            "stages": pp,
+            "micro_batches": self._num_micro,
+            "layers": self._pipe_spec.num_layers,
+            "schedule": (self.telemetry.meta.get("pipeline") or
+                         {}).get("schedule"),
+            "flops_per_stage": [per_stage] * pp,
+            "attribution": "jaxpr-walk total split across SPMD stages "
+                           "(uniform by construction)",
+        }
+        # Module-level breakdown for the operator reading TELEMETRY.json
+        # ("where do the flops go") — captured by the SAME jaxpr walk
+        # path_cost already ran; re-tracing the whole pipelined program
+        # here would double the build's blocking time.
+        if train.get("top_modules"):
+            section["top_modules"] = train["top_modules"]
+        return {"pipeline": section}
+
     @staticmethod
     def _peek_param_dict(config):
         """Normalize any accepted config form to its raw param dict, for

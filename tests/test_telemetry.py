@@ -15,6 +15,7 @@ Acceptance gates from the PR issue:
 import importlib.util
 import json
 import os
+import time
 
 import jax
 import jax.numpy as jnp
@@ -22,8 +23,9 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu.utils.timer as timer_mod
-from deepspeed_tpu.monitor import (JsonlSink, MemoryWatermark,
-                                   RecompileError, RecompileSentinel,
+from deepspeed_tpu.monitor import (GOODPUT_BUCKETS, JsonlSink,
+                                   MemoryWatermark, RecompileError,
+                                   RecompileSentinel,
                                    analytic_state_bytes,
                                    device_memory_stats)
 from deepspeed_tpu.monitor.recompile import signature_delta
@@ -612,6 +614,58 @@ class TestEndToEndReport:
         assert summary["meta"]["dp"] == 8
         assert summary["skipped_steps"] == 0
 
+        # --- roofline cost model: one cost_model record, per-path
+        # verdicts validated against the wire model --- #
+        recs = read_jsonl(tmp_path)
+        cms = [r for r in recs if r["kind"] == "cost_model"]
+        assert len(cms) == 1
+        cm = cms[0]
+        train = cm["paths"]["train_step"]
+        assert train["available"]
+        assert train["bound"] in ("compute", "hbm", "interconnect")
+        # comm priced from the PR-3 wire model at the RESOLVED lowering.
+        assert train["comm_bytes"] == expected_wire
+        assert train["analytic_flops"] > 0
+        assert cm["step"]["floor_ms"] > 0
+        assert cm["chip"]["assumed"]   # CPU mesh: v5e peaks, flagged
+
+        # --- per-step MFU + fenced window MFU --- #
+        step_recs = [r for r in recs if r["kind"] == "step"]
+        assert all(0 < s["mfu"] < 1 for s in step_recs)
+        report_recs = [r for r in recs if r["kind"] == "report"]
+        assert any(0 < r.get("window_mfu", 0) < 1 for r in report_recs)
+
+        # --- goodput ledger: every settled window sums to its wall
+        # within 1% and is consistent; the post-step checkpoint wall
+        # lands in the close-drain window --- #
+        gp_windows = [r["goodput"] for r in report_recs
+                      if isinstance(r.get("goodput"), dict)]
+        assert gp_windows
+        for w in gp_windows:
+            total = sum(w[f"{b}_s"] for b in GOODPUT_BUCKETS)
+            assert abs(total - w["window_s"]) <= 0.01 * w["window_s"] \
+                + 1e-9
+            assert w["consistent"]
+        assert sum(w["checkpoint_s"] for w in gp_windows) > 0
+        # cold-start compile wall is attributed, not hidden
+        assert sum(w["recompile_s"] for w in gp_windows) > 0
+
+        # --- TELEMETRY.json grew the three sections --- #
+        assert summary["mfu"]["available"]
+        assert summary["mfu"]["peak_assumed"]
+        assert 0 < summary["mfu"]["window_mfu"] < 1
+        assert summary["roofline"]["available"]
+        assert summary["roofline"]["step_bound"] in (
+            "compute", "hbm", "interconnect")
+        assert summary["roofline"]["paths"]["train_step"]["bound"] == \
+            train["bound"]
+        assert summary["roofline"]["measured_p50_over_floor"] > 0
+        assert summary["goodput"]["available"]
+        assert summary["goodput"]["consistent"]
+        assert summary["goodput"]["accounted_fraction"] == \
+            pytest.approx(1.0, abs=0.01)
+        assert summary["goodput"]["windows"] == len(gp_windows)
+
         # --- Chrome-trace pair: valid JSON (array form, terminated at
         # close) with the expected spans --- #
         trace = json.load(open(trace_path))
@@ -630,3 +684,299 @@ class TestEndToEndReport:
         losses = [float(engine.train_batch(batch=batch))
                   for _ in range(15)]
         assert losses[-1] < losses[0] * 0.8
+
+
+# --------------------------------------------------------------------- #
+# Goodput ledger wired through the engine
+# --------------------------------------------------------------------- #
+class SlowDataset:
+    """Indexable dataset whose item access sleeps — the injected data
+    stall the goodput ledger must see."""
+
+    def __init__(self, n=64, dim=8, delay_s=0.002):
+        rng = np.random.default_rng(0)
+        self.x = rng.normal(size=(n, dim)).astype(np.float32)
+        self.y = (self.x.sum(axis=1) > 0).astype(np.int32)
+        self.delay_s = delay_s
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        time.sleep(self.delay_s)
+        return self.x[i], self.y[i]
+
+
+def _assert_sums_to_wall(window):
+    """The acceptance identity: buckets sum to window wall within 1%."""
+    total = sum(window[f"{b}_s"] for b in GOODPUT_BUCKETS)
+    assert abs(total - window["window_s"]) <= \
+        0.01 * window["window_s"] + 1e-9
+    assert window["consistent"]
+
+
+class TestGoodputEngine:
+    def test_slow_dataset_stall_lands_in_ledger(self, tmp_path):
+        delay = 0.002
+        cfg = base_config()
+        cfg["telemetry"] = telemetry_config(tmp_path, report_steps=5)
+        engine = DeepSpeedEngine(
+            model=simple_loss_fn,
+            model_params=simple_model_params(jax.random.PRNGKey(0)),
+            config=cfg, training_data=SlowDataset(delay_s=delay))
+        for _ in range(5):
+            engine.train_batch()
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        w = next(r["goodput"] for r in recs if r["kind"] == "report")
+        # 5 steps x 16 samples x injected sleep: sleep() only ever
+        # overshoots, so the stall floor is exact.
+        expected = 5 * 16 * delay
+        assert w["data_stall_s"] >= expected
+        assert w["data_stall_s"] < w["window_s"]
+        assert w["useful_compute_s"] >= 0
+        _assert_sums_to_wall(w)
+        assert w["accounted_fraction"] == pytest.approx(1.0)
+        # the loader-local counter sees the same stall (dataset access
+        # + collate happen inside the loader's __next__)
+        assert engine.training_dataloader.cumulative_fetch_wait_s() >= \
+            expected
+
+    def test_recompile_wall_attributed(self, tmp_path):
+        engine = make_engine(tmp_path, tel_knobs={"report_steps": 3})
+        for _ in range(3):
+            engine.train_batch(batch=random_batch(n=16))  # cold compile
+        for _ in range(3):
+            engine.train_batch(batch=random_batch(n=32))  # induced retrace
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        gps = [r["goodput"] for r in recs if r["kind"] == "report"]
+        assert len(gps) >= 2
+        assert gps[0]["recompile_s"] > 0    # cold start is real lost wall
+        assert gps[1]["recompile_s"] > 0    # the retrace window
+        for w in gps:
+            _assert_sums_to_wall(w)
+        # ledger windows partition the sentinel's cumulative compile wall
+        total = sum(g["recompile_s"] for g in gps)
+        assert total == pytest.approx(
+            engine.telemetry.sentinel.compile_wall_s, rel=1e-3, abs=1e-5)
+
+    def test_overflow_skipped_steps_attributed(self, tmp_path):
+        engine = make_engine(
+            tmp_path, tel_knobs={"report_steps": 4},
+            fp16={"enabled": True, "initial_scale_power": 8,
+                  "hysteresis": 1})
+        x, y = random_batch(n=16)
+        bad = (np.full_like(x, np.nan), y)
+        for batch in [(x, y), bad, bad, (x, y)]:
+            engine.train_batch(batch=batch)
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert [s["overflow"] for s in steps] == [False, True, True, False]
+        w = next(r["goodput"] for r in recs if r["kind"] == "report")
+        # overflow-skipped wall == exactly the overflow steps' wall
+        # (work executed, result discarded — not useful compute)
+        expected = sum(s["wall_ms"] for s in steps if s["overflow"]) / 1e3
+        assert w["overflow_skipped_s"] == pytest.approx(
+            expected, rel=1e-3, abs=1e-6)
+        assert w["overflow_skipped_s"] > 0
+        _assert_sums_to_wall(w)
+
+    def test_first_step_overflow_during_cold_compile(self, tmp_path):
+        """The first step both cold-compiles AND overflows: the compile
+        wall (inside that step's wall) must land in recompile, not be
+        double-counted against the overflow bucket — the window stays
+        consistent and useful_compute non-negative."""
+        engine = make_engine(
+            tmp_path, tel_knobs={"report_steps": 3},
+            fp16={"enabled": True, "initial_scale_power": 8,
+                  "hysteresis": 1})
+        x, y = random_batch(n=16)
+        bad = (np.full_like(x, np.nan), y)
+        for batch in [bad, (x, y), (x, y)]:
+            engine.train_batch(batch=batch)
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        steps = [r for r in recs if r["kind"] == "step"]
+        assert steps[0]["overflow"] and not steps[1]["overflow"]
+        w = next(r["goodput"] for r in recs if r["kind"] == "report")
+        assert w["recompile_s"] > 0
+        assert w["overflow_skipped_s"] >= 0
+        assert w["useful_compute_s"] >= 0
+        _assert_sums_to_wall(w)
+
+    def test_trailing_checkpoint_settles_at_close(self, tmp_path):
+        """A checkpoint saved after the last report boundary must not
+        vanish: close() settles the ledger even with an empty ring."""
+        engine = make_engine(tmp_path, tel_knobs={"report_steps": 2})
+        for _ in range(2):
+            engine.train_batch(batch=random_batch(n=16))  # drains at 2
+        engine.save_checkpoint(str(tmp_path / "ckpt"))
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        gps = [r["goodput"] for r in recs if r["kind"] == "report"]
+        assert len(gps) == 2            # boundary + close settlement
+        assert gps[-1]["steps"] == 0
+        assert gps[-1]["checkpoint_s"] > 0
+        _assert_sums_to_wall(gps[-1])
+
+
+# --------------------------------------------------------------------- #
+# Roofline cost model wired through the engine
+# --------------------------------------------------------------------- #
+class TestCostModelEngine:
+    def test_disabled_knob_writes_no_record(self, tmp_path):
+        engine = make_engine(tmp_path, tel_knobs={"report_steps": 2,
+                                                  "cost_model": False})
+        for _ in range(2):
+            engine.train_batch(batch=random_batch(n=16))
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        assert not [r for r in recs if r["kind"] == "cost_model"]
+        assert all("mfu" not in r for r in recs if r["kind"] == "step")
+
+    def test_build_failure_degrades_to_event(self, tmp_path, monkeypatch):
+        """Observability must never kill training: a cost-model build
+        crash becomes a structured event and the run continues."""
+        import deepspeed_tpu.monitor.cost_model as cm_mod
+
+        def boom(*a, **k):
+            raise RuntimeError("synthetic cost-model failure")
+
+        monkeypatch.setattr(cm_mod, "build_cost_model", boom)
+        engine = make_engine(tmp_path, tel_knobs={"report_steps": 2})
+        losses = [float(engine.train_batch(batch=random_batch(n=16)))
+                  for _ in range(4)]
+        assert all(np.isfinite(losses))
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        evs = [r for r in recs if r["kind"] == "event"
+               and r["event"] == "cost_model_error"]
+        assert len(evs) == 1            # built once, failed once
+        assert "synthetic cost-model failure" in evs[0]["error"]
+        assert not [r for r in recs if r["kind"] == "cost_model"]
+
+    def test_offload_path_priced(self, tmp_path):
+        engine = TestOffloadTelemetry().make_offload_engine(
+            tmp_path, overlap=False)
+        engine.train_batch(batch=random_batch(n=4))   # report_steps=1
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        cm = next(r for r in recs if r["kind"] == "cost_model")
+        assert cm["step"]["paths"] == {"offload_grad_step": 1.0}
+        p = cm["paths"]["offload_grad_step"]
+        assert p["available"] and p["analytic_flops"] > 0
+        assert cm["step"]["missing_paths"] == []
+
+    def test_trio_path_priced_with_gas_weighting(self, tmp_path):
+        """forward/backward/step trio: grad_step priced gas x, the apply
+        once — the fused step total reconciles both programs."""
+        cfg = base_config(train_batch_size=16,
+                          gradient_accumulation_steps=2)
+        cfg["telemetry"] = telemetry_config(tmp_path, report_steps=1)
+        engine = DeepSpeedEngine(
+            model=simple_loss_fn,
+            model_params=simple_model_params(jax.random.PRNGKey(0)),
+            config=cfg)
+        x, y = random_batch(n=16)
+        for mb in [(x[:8], y[:8]), (x[8:], y[8:])]:
+            loss = engine.forward(mb)
+            engine.backward(loss)
+            engine.step()
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        cm = next(r for r in recs if r["kind"] == "cost_model")
+        assert cm["step"]["paths"] == {"grad_step": 2.0, "apply_grads": 1.0}
+        assert cm["paths"]["grad_step"]["available"]
+        assert cm["paths"]["apply_grads"]["available"]
+        assert cm["step"]["missing_paths"] == []
+        # fused flops: gas x grad program + 1 x apply program
+        expected = 2 * cm["paths"]["grad_step"]["analytic_flops"] + \
+            cm["paths"]["apply_grads"]["analytic_flops"]
+        assert cm["step"]["flops_per_step"] == pytest.approx(expected)
+
+    def test_build_adds_no_device_fences(self, tmp_path):
+        """The cost-model build is host-side AOT work: re-lowering every
+        registered path must issue ZERO device fences — asserted with
+        the instrumented counter, not trusted."""
+        engine = make_engine(tmp_path, tel_knobs={"report_steps": 10 ** 9})
+        engine.train_batch(batch=random_batch(n=16))
+        before = timer_mod.device_sync_count()
+        engine._maybe_build_cost_model()
+        assert engine.telemetry.cost_model_payload is not None
+        assert timer_mod.device_sync_count() == before
+
+    def test_wire_bytes_priced_on_grad_path(self, tmp_path, mesh8):
+        """The cost model prices the PR-3 wire model's resolved bytes on
+        the grad-computing path — interconnect ceiling is wire-model
+        ground truth, not a guess."""
+        cfg = base_config(**{"zero_optimization": {"stage": 2}})
+        cfg["telemetry"] = telemetry_config(tmp_path, report_steps=2)
+        engine = DeepSpeedEngine(
+            model=simple_loss_fn,
+            model_params=simple_model_params(jax.random.PRNGKey(0)),
+            config=cfg, mesh=mesh8)
+        for _ in range(2):
+            engine.train_batch(batch=random_batch(n=16))
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        cm = next(r for r in recs if r["kind"] == "cost_model")
+        meta = next(r for r in recs if r["kind"] == "meta")
+        assert cm["paths"]["train_step"]["comm_bytes"] == \
+            meta["wire_bytes_per_step"]
+        assert cm["n_devices"] == 8
+
+
+# --------------------------------------------------------------------- #
+# Pipeline engine: per-stage cost attribution
+# --------------------------------------------------------------------- #
+class TestPipelineCostModel:
+    def test_per_stage_attribution(self, tmp_path):
+        from deepspeed_tpu.parallel.topology import build_mesh
+        from deepspeed_tpu.runtime.pipe.engine import PipelineEngine
+        from deepspeed_tpu.runtime.pipe.module import PipelineModule
+
+        def block(p, x):
+            return jnp.tanh(x @ p["w"] + p["b"])
+
+        L, D = 4, 8
+        params = {
+            f"layer_{i}": {
+                "w": jax.random.normal(jax.random.PRNGKey(i), (D, D)) * 0.3,
+                "b": jnp.zeros((D,))}
+            for i in range(L)}
+        module = PipelineModule(
+            [block] * L, num_stages=2,
+            loss_fn=lambda x, labels: jnp.mean(
+                (x.sum(axis=(-1, -2)) - labels) ** 2),
+            partition_method="uniform")
+        spec = module.to_pipe_spec(params)
+        cfg = {"train_batch_size": 4, "train_micro_batch_size_per_gpu": 2,
+               "gradient_accumulation_steps": 2,
+               "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+               "steps_per_print": 10 ** 9,
+               "telemetry": telemetry_config(tmp_path, report_steps=1)}
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(4, 4, D)).astype(np.float32)
+        y = x.sum(axis=(-1, -2))
+        # pp=2 x dp=1: stays inside this jax's shard_map capability
+        # envelope (pp>1 x dp>1 needs partial-auto — see capability.py)
+        mesh_pp = build_mesh(pp=2, devices=jax.devices()[:2])
+        engine = PipelineEngine(model=spec, config=cfg, mesh=mesh_pp)
+        engine.train_batch((x, y))
+        engine.telemetry.close()
+        recs = read_jsonl(tmp_path)
+        cm = next(r for r in recs if r["kind"] == "cost_model")
+        pipe = cm["pipeline"]
+        assert pipe["stages"] == 2 and pipe["layers"] == L
+        # uniform SPMD split: per-stage flops sum back to the analytic
+        # total of the whole pipelined step program
+        assert len(pipe["flops_per_stage"]) == 2
+        assert sum(pipe["flops_per_stage"]) == pytest.approx(
+            cm["paths"]["train_step"]["analytic_flops"])
+        assert pipe["schedule"] in ("gpipe", "1f1b")
+        assert pipe["micro_batches"] >= 1
+        # module-level breakdown from the same jaxpr walk
+        assert pipe["top_modules"]
+        assert all(m["flops"] >= 0 for m in pipe["top_modules"])

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Bench gate: fail CI when MFU or goodput regresses between rounds.
+
+Usage:
+    python tools/bench_gate.py                      # latest two BENCH_r*.json
+    python tools/bench_gate.py OLD NEW              # explicit files
+    python tools/bench_gate.py --mfu-drop 0.10 --goodput-drop 0.05
+
+Accepted file shapes (auto-detected per file):
+
+- a driver round file ``BENCH_r*.json`` (``{"n": .., "parsed": {bench
+  record}}``) — MFU comes from the bench record's ``mfu`` field (the
+  shared monitor/peaks.py denominator);
+- a raw bench record (the JSON line bench.py prints);
+- a ``TELEMETRY.json`` from tools/telemetry_report.py — MFU is the
+  fenced ``window_mfu`` (per-step p50 as fallback), goodput is the
+  ledger's ``goodput_fraction``.
+
+Gate semantics: MFU regresses when it drops by more than ``--mfu-drop``
+RELATIVE (default 10%); goodput regresses when the fraction drops by
+more than ``--goodput-drop`` ABSOLUTE (default 5 points). A metric
+missing on either side is skipped with a notice, never a failure —
+rounds recorded before this tool existed have no ``mfu`` field, and the
+gate must not retroactively break them. Exit 0 = pass/skip, 1 =
+regression, 2 = usage error.
+
+Opt-in from CI: ``tools/run_tier1.sh --bench-gate`` (or BENCH_GATE=1).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+
+def _load(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        return json.load(f)
+
+
+def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
+    """{"mfu", "goodput"} (None when the file doesn't carry one)."""
+    # Driver round file: the bench record rides in "parsed".
+    if isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    mfu: Optional[float] = None
+    goodput: Optional[float] = None
+    # TELEMETRY.json shape: structured mfu/goodput sections.
+    if isinstance(doc.get("mfu"), dict):
+        sec = doc["mfu"]
+        v = sec.get("window_mfu", sec.get("per_step_p50"))
+        mfu = float(v) if v is not None else None
+    elif isinstance(doc.get("mfu"), (int, float)):
+        # Bench record shape: flat fraction-of-peak field.
+        mfu = float(doc["mfu"])
+    if isinstance(doc.get("goodput"), dict):
+        v = doc["goodput"].get("goodput_fraction")
+        goodput = float(v) if v is not None else None
+    return {"mfu": mfu, "goodput": goodput}
+
+
+def _round_key(path: str) -> Tuple[int, str]:
+    m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
+    return (int(m.group(1)) if m else -1, path)
+
+
+def latest_rounds(directory: str) -> Optional[Tuple[str, str]]:
+    """The previous and latest BENCH_r*.json in ``directory`` (round
+    number order), or None when fewer than two exist."""
+    rounds = sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")),
+                    key=_round_key)
+    # Driver side files like BENCH_r04_builder.json are not rounds.
+    rounds = [p for p in rounds
+              if re.fullmatch(r"BENCH_r\d+\.json", os.path.basename(p))]
+    if len(rounds) < 2:
+        return None
+    return rounds[-2], rounds[-1]
+
+
+def gate(old_path: str, new_path: str, mfu_drop: float,
+         goodput_drop: float) -> int:
+    old = extract_metrics(_load(old_path))
+    new = extract_metrics(_load(new_path))
+    name_old, name_new = os.path.basename(old_path), \
+        os.path.basename(new_path)
+    rc = 0
+    compared = 0
+
+    if old["mfu"] is not None and new["mfu"] is not None:
+        compared += 1
+        floor = old["mfu"] * (1.0 - mfu_drop)
+        verdict = "OK" if new["mfu"] >= floor else "REGRESSION"
+        print(f"mfu: {name_old}={old['mfu']:.4g} -> "
+              f"{name_new}={new['mfu']:.4g} "
+              f"(floor {floor:.4g}, -{mfu_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["mfu"] is None]
+        print(f"mfu: skipped (no mfu field in {', '.join(missing)})")
+
+    if old["goodput"] is not None and new["goodput"] is not None:
+        compared += 1
+        floor = old["goodput"] - goodput_drop
+        verdict = "OK" if new["goodput"] >= floor else "REGRESSION"
+        print(f"goodput: {name_old}={old['goodput']:.4f} -> "
+              f"{name_new}={new['goodput']:.4f} "
+              f"(floor {floor:.4f}, -{goodput_drop:.2f} abs): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["goodput"] is None]
+        print(f"goodput: skipped (no goodput section in "
+              f"{', '.join(missing)})")
+
+    if compared == 0:
+        print("bench_gate: nothing comparable between the two files "
+              "(pre-MFU rounds?) — passing")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("files", nargs="*",
+                    help="OLD NEW (default: latest two BENCH_r*.json)")
+    ap.add_argument("--dir", default=".",
+                    help="where to glob BENCH_r*.json (default .)")
+    ap.add_argument("--mfu-drop", type=float, default=0.10,
+                    help="max tolerated RELATIVE MFU drop (default 0.10)")
+    ap.add_argument("--goodput-drop", type=float, default=0.05,
+                    help="max tolerated ABSOLUTE goodput-fraction drop "
+                         "(default 0.05)")
+    args = ap.parse_args(argv)
+    if len(args.files) == 2:
+        old_path, new_path = args.files
+    elif not args.files:
+        pair = latest_rounds(args.dir)
+        if pair is None:
+            print("bench_gate: fewer than two BENCH_r*.json rounds in "
+                  f"{args.dir!r} — nothing to gate, passing")
+            return 0
+        old_path, new_path = pair
+    else:
+        ap.error("pass exactly two files, or none for auto-discovery")
+        return 2
+    try:
+        return gate(old_path, new_path, args.mfu_drop, args.goodput_drop)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"bench_gate: cannot read inputs: {e}")
+        return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,4 +1,11 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the ROADMAP.md command, verbatim. Green = safe to ship.
+# Opt-in: --bench-gate (or BENCH_GATE=1) additionally diffs the latest
+# two bench rounds' MFU/goodput via tools/bench_gate.py and fails on
+# regression beyond threshold.
 cd "$(dirname "$0")/.." || exit 1
-set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
+if [ "${1:-}" = "--bench-gate" ] || [ "${BENCH_GATE:-0}" = "1" ]; then
+  python tools/bench_gate.py || rc=1
+fi
+exit $rc

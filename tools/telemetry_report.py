@@ -5,8 +5,8 @@ Usage:
     python tools/telemetry_report.py runs/MyJob.jsonl [-o TELEMETRY.json]
 
 Reads the line records the monitor/ subsystem emits (kind: meta | step |
-report | event) and produces one machine-diffable summary so benches and
-CI can compare runs:
+report | event | cost_model) and produces one machine-diffable summary
+so benches and CI can compare runs:
 
 - step time p50/p95/mean (ms) — per-step host wall. On the jitted paths
   this is DISPATCH wall (steps pipeline asynchronously); the fenced
@@ -21,6 +21,16 @@ CI can compare runs:
   check between the meta record and the per-step records.
 - overflow/skipped-step counts and dropped-record accounting (a ring
   overflow between drains is reported, never silent).
+- ``mfu``: per-step MFU stats (dispatch-wall based) plus the fenced
+  ``window_mfu`` from the last closed throughput window.
+- ``roofline``: the cost_model record's per-path verdicts
+  (compute/HBM/interconnect-bound), the fused per-step analytic floor,
+  and measured-p50 vs floor (how far the run sits from the ceiling).
+- ``goodput``: bucket totals aggregated across every settled window,
+  the goodput fraction, and the sum-to-wall consistency verdict.
+
+``tools/bench_gate.py`` diffs the mfu/goodput sections across bench
+rounds and fails CI on regression.
 """
 from __future__ import annotations
 
@@ -51,6 +61,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
     steps: List[Dict[str, Any]] = []
     reports: List[Dict[str, Any]] = []
     events: List[Dict[str, Any]] = []
+    cost_model: Dict[str, Any] = {}
     with open(jsonl_path) as f:
         for line in f:
             line = line.strip()
@@ -63,12 +74,15 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             kind = rec.get("kind")
             if kind == "meta":
                 meta, steps, reports, events = dict(rec), [], [], []
+                cost_model = {}
             elif kind == "step":
                 steps.append(rec)
             elif kind == "report":
                 reports.append(rec)
             elif kind == "event":
                 events.append(rec)
+            elif kind == "cost_model":
+                cost_model = dict(rec)
 
     walls = sorted(float(r["wall_ms"]) for r in steps if "wall_ms" in r)
     recompiles = [e for e in events if e.get("event") == "recompile"]
@@ -109,6 +123,85 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         if "skipped_steps" in rep:
             skipped = int(rep["skipped_steps"])
             break
+
+    # MFU: per-step figures are dispatch-wall based (honest but loose on
+    # jitted paths); window_mfu comes from the fenced throughput window.
+    step_mfus = [float(r["mfu"]) for r in steps if "mfu" in r]
+    window_mfu: Optional[float] = None
+    for rep in reversed(reports):
+        if "window_mfu" in rep:
+            window_mfu = float(rep["window_mfu"])
+            break
+    mfu: Dict[str, Any] = {"available": bool(step_mfus)}
+    if step_mfus:
+        s = sorted(step_mfus)
+        mfu.update({
+            "per_step_mean": float(f"{sum(s) / len(s):.4g}"),
+            "per_step_p50": float(f"{_percentile(s, 50):.4g}"),
+            "n": len(s),
+        })
+    if window_mfu is not None:
+        mfu["window_mfu"] = window_mfu
+    chip = cost_model.get("chip") or {}
+    if chip:
+        mfu["peak_bf16_tflops"] = chip.get("bf16_tflops")
+        mfu["peak_assumed"] = bool(chip.get("assumed"))
+
+    # Roofline: the cost_model record, slimmed to the decision fields,
+    # plus measured-vs-floor (dispatch p50 over the analytic floor — how
+    # far the run sits from the perfect-overlap ceiling; <1 would mean
+    # the model is wrong or the wall clock lies).
+    roofline: Dict[str, Any] = {"available": bool(cost_model)}
+    if cost_model:
+        cm_step = cost_model.get("step") or {}
+        paths = {}
+        for name, p in (cost_model.get("paths") or {}).items():
+            if not isinstance(p, dict):
+                continue
+            paths[name] = {k: p.get(k) for k in
+                           ("bound", "floor_ms", "t_compute_ms", "t_hbm_ms",
+                            "t_comm_ms", "scan_scale", "available")
+                           if k in p}
+        roofline.update({
+            "chip": chip,
+            "n_devices": cost_model.get("n_devices"),
+            "paths": paths,
+            "step_bound": cm_step.get("bound"),
+            "step_floor_ms": cm_step.get("floor_ms"),
+            "flops_per_step": cm_step.get("flops_per_step"),
+            "missing_paths": cm_step.get("missing_paths"),
+        })
+        floor = cm_step.get("floor_ms")
+        p50 = _percentile(walls, 50)
+        if floor and p50 > 0:
+            roofline["measured_p50_over_floor"] = round(p50 / floor, 3)
+
+    # Goodput: aggregate every settled window. The per-window sum-to-wall
+    # identity holds by construction (other is the residual); the real
+    # checks are each window's `consistent` flag (no double-attribution)
+    # and the aggregated accounted fraction.
+    gp_windows = [rep["goodput"] for rep in reports
+                  if isinstance(rep.get("goodput"), dict)]
+    goodput: Dict[str, Any] = {"available": bool(gp_windows)}
+    if gp_windows:
+        bucket_keys = [k for k in gp_windows[0]
+                       if k.endswith("_s") and k != "window_s"]
+        totals = {k: sum(float(w.get(k, 0.0)) for w in gp_windows)
+                  for k in bucket_keys}
+        total_window = sum(float(w.get("window_s", 0.0)) for w in gp_windows)
+        goodput.update({
+            "windows": len(gp_windows),
+            "total_window_s": round(total_window, 6),
+            "buckets_s": {k[:-2]: round(v, 6) for k, v in totals.items()},
+            "goodput_fraction": round(
+                totals.get("useful_compute_s", 0.0) / total_window, 6)
+                if total_window > 0 else 0.0,
+            "accounted_fraction": round(
+                sum(totals.values()) / total_window, 6)
+                if total_window > 0 else 1.0,
+            "consistent": all(w.get("consistent", False)
+                              for w in gp_windows),
+        })
 
     offload_steps = [r["offload"] for r in steps
                      if isinstance(r.get("offload"), dict)]
@@ -154,6 +247,9 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "overflow_steps": overflows,
         "skipped_steps": skipped,
         "offload": offload,
+        "mfu": mfu,
+        "roofline": roofline,
+        "goodput": goodput,
     }
 
 
@@ -167,10 +263,17 @@ def main(argv=None) -> int:
     with open(args.output, "w") as f:
         json.dump(summary, f, indent=2)
     st = summary["step_time_ms"]
+    mfu = summary["mfu"].get("window_mfu") or \
+        summary["mfu"].get("per_step_p50")
+    gp = summary["goodput"].get("goodput_fraction")
+    bound = summary["roofline"].get("step_bound")
     print(f"{args.output}: {summary['steps_recorded']} steps, "
           f"p50={st['p50']}ms p95={st['p95']}ms, "
           f"recompiles={summary['recompiles']['count']}, "
-          f"watermarks={summary['memory']['watermark_events']}")
+          f"watermarks={summary['memory']['watermark_events']}"
+          + (f", mfu={mfu}" if mfu is not None else "")
+          + (f", {bound}-bound" if bound else "")
+          + (f", goodput={gp:.1%}" if gp is not None else ""))
     return 0
 
 
