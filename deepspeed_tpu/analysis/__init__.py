@@ -1,0 +1,42 @@
+"""Compile-time program auditor: a lint suite over jaxpr + optimized HLO.
+
+The static-analysis layer the repo's "verifiable by construction" story
+stands on: every compiled step path an engine owns is re-lowered
+host-side (from the recompile sentinel's recorded abstract signatures —
+zero device fences) and run through five passes: materialization,
+dtype_flow, donation, host_sync, collective_placement. Findings are
+structured, waivable, and CI-gated via ``tools/ds_lint.py`` +
+``LINT_AUDIT.json``. See docs/tutorials/static_analysis.md.
+
+Submodule imports are lazy so ``parallel/hlo_audit.py`` can import
+``analysis.hlo_text`` without pulling jax-heavy modules (or itself,
+transitively) at package-import time.
+"""
+from __future__ import annotations
+
+_LAZY = {
+    "hlo_text": ".hlo_text",
+    "findings": ".findings",
+    "passes": ".passes",
+    "auditor": ".auditor",
+    # Convenience re-exports.
+    "LintConfig": ".findings", "LintFinding": ".findings",
+    "LintReport": ".findings", "Waiver": ".findings",
+    "load_waivers": ".findings", "apply_waivers": ".findings",
+    "PASSES": ".passes",
+    "lint_jit": ".auditor", "lint_engine": ".auditor",
+    "lint_sentinel": ".auditor", "lint_path": ".auditor",
+}
+
+__all__ = sorted(_LAZY)
+
+
+def __getattr__(name):
+    mod_name = _LAZY.get(name)
+    if mod_name is None:
+        raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+    mod = importlib.import_module(mod_name, __name__)
+    if name in ("hlo_text", "findings", "passes", "auditor"):
+        return mod
+    return getattr(mod, name)

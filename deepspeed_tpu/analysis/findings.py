@@ -1,0 +1,204 @@
+"""Structured lint findings, waivers, and the report they assemble into.
+
+A finding is one defect a lint pass proved about one compiled program:
+stable enough to baseline (its ``fingerprint`` survives recompiles and
+instruction renumbering), priced where the wire model applies, and JSON-
+ready for ``LINT_AUDIT.json``. The waiver file is the CI contract: every
+KNOWN-and-roadmapped finding is matched by a waiver (so it doesn't block
+the build), every waiver must match a live finding (so the baseline
+can't rot — a stale waiver is itself reported), and any NEW finding
+fails the gate.
+"""
+from __future__ import annotations
+
+import dataclasses
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARN = "warn"
+
+
+@dataclasses.dataclass
+class LintFinding:
+    """One verified defect in one compiled program.
+
+    ``key`` is the pass-specific stable discriminator (shape/dtype/op
+    attribution — never an HLO instruction id, which changes across
+    compiles). ``priced`` says whether ``wire_bytes`` came from the ring
+    wire model; unpriced findings carry their buffer ``bytes`` instead,
+    so every record is explicitly one or the other.
+    """
+    lint: str                 # pass name (materialization, dtype_flow, ...)
+    path: str                 # compiled-program name (train_step, ...)
+    key: str                  # stable discriminator within (lint, path)
+    summary: str
+    severity: str = SEVERITY_ERROR
+    bytes: int = 0            # buffer bytes the finding is about
+    wire_bytes: Optional[int] = None
+    priced: bool = False      # wire_bytes from the ring wire model
+    in_loop: bool = False     # inside a while/scan body (per-trip cost)
+    count: int = 1            # occurrences aggregated into this record
+    details: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.lint}:{self.path}:{self.key}"
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = dataclasses.asdict(self)
+        d["fingerprint"] = self.fingerprint
+        if not self.priced:
+            d.pop("wire_bytes", None)
+        return d
+
+
+@dataclasses.dataclass
+class Waiver:
+    """One baseline entry: a glob over fingerprints plus the reason the
+    finding is tolerated (ideally a ROADMAP pointer — waivers are debts,
+    not absolutions).
+
+    ``match`` supports ``*`` only (any run of characters) — NOT full
+    fnmatch, whose ``[...]`` character classes would silently swallow
+    the HLO shape brackets every fingerprint contains."""
+    match: str
+    reason: str = ""
+    roadmap: str = ""
+
+    def matches(self, finding: LintFinding) -> bool:
+        import re
+        pat = ".*".join(re.escape(p) for p in self.match.split("*"))
+        return re.fullmatch(pat, finding.fingerprint) is not None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"match": self.match, "reason": self.reason,
+                "roadmap": self.roadmap}
+
+
+def load_waivers(path: str) -> List[Waiver]:
+    """Read a waiver file: ``{"waivers": [{"match", "reason", "roadmap"}]}``.
+    Missing file = empty baseline (everything unwaived)."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except FileNotFoundError:
+        return []
+    return [Waiver(match=w["match"], reason=w.get("reason", ""),
+                   roadmap=w.get("roadmap", ""))
+            for w in doc.get("waivers", [])]
+
+
+def apply_waivers(findings: Sequence[LintFinding], waivers: Sequence[Waiver]
+                  ) -> Tuple[List[LintFinding],
+                             List[Tuple[LintFinding, Waiver]],
+                             List[Waiver]]:
+    """Split ``findings`` into (unwaived, waived-with-their-waiver) and
+    return the STALE waivers — entries that matched nothing. Staleness is
+    judged over this finding set only; tools sweeping several configs
+    aggregate before judging (a waiver for config B is not stale while
+    auditing config A)."""
+    unwaived: List[LintFinding] = []
+    waived: List[Tuple[LintFinding, Waiver]] = []
+    used: set = set()
+    for f in findings:
+        hit = next((w for w in waivers if w.matches(f)), None)
+        if hit is None:
+            unwaived.append(f)
+        else:
+            waived.append((f, hit))
+            used.add(hit.match)
+    stale = [w for w in waivers if w.match not in used]
+    return unwaived, waived, stale
+
+
+@dataclasses.dataclass
+class LintConfig:
+    """Pass thresholds. Defaults are tuned so the clean engine paths on
+    the dp=8 CPU mesh produce zero findings while the seeded-violation
+    tests (and the real fused-chunk/offload findings) still fire."""
+    # materialization: flag an intermediate whose largest buffer exceeds
+    # this fraction of the declared (per-device, sharded) state bytes...
+    materialize_fraction: float = 1.0
+    # ...with an absolute floor so byte-level noise on toy models can be
+    # suppressed when a caller wants real-model scales only.
+    materialize_floor_bytes: int = 0
+    # donation: minimum unreturned donated bytes worth a finding.
+    donation_floor_bytes: int = 0
+    # dtype_flow: minimum round-tripped buffer bytes worth a finding.
+    dtype_floor_bytes: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class LintContext:
+    """Everything a pass may inspect about ONE compiled program. Built
+    host-side by the auditor from the recompile sentinel's recorded
+    ``fn``/``abstract_args`` (zero device fences by construction)."""
+    name: str                     # program/path name
+    jaxpr: Any                    # ClosedJaxpr of the program body
+    donated_invars: Tuple[bool, ...]   # per flat input, jit declaration
+    in_avals: Tuple[Any, ...]     # flat input avals (aligned with donated)
+    hlo_text: str                 # optimized HLO text (compiled, per-device)
+    audit: Any                    # parallel.hlo_audit.CommAudit of hlo_text
+    # Flat-input indices the executable KEPT as entry parameters (jit
+    # drops unused args under keep_unused=False); None = all kept. Maps
+    # entry param numbers back onto donated_invars/in_avals indices.
+    kept_var_idx: Optional[Tuple[int, ...]] = None
+    meta: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    config: LintConfig = dataclasses.field(default_factory=LintConfig)
+
+
+@dataclasses.dataclass
+class PathResult:
+    """One program's lint outcome (pre-waiver)."""
+    name: str
+    findings: List[LintFinding] = dataclasses.field(default_factory=list)
+    errors: List[str] = dataclasses.field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name,
+                "findings": [f.to_dict() for f in self.findings],
+                "errors": list(self.errors)}
+
+
+@dataclasses.dataclass
+class LintReport:
+    """Aggregated outcome over every audited path, waivers applied."""
+    paths: List[PathResult]
+    unwaived: List[LintFinding]
+    waived: List[Tuple[LintFinding, Waiver]]
+    stale_waivers: List[Waiver]
+    config: LintConfig = dataclasses.field(default_factory=LintConfig)
+
+    @property
+    def findings(self) -> List[LintFinding]:
+        return [f for p in self.paths for f in p.findings]
+
+    @property
+    def errors(self) -> List[str]:
+        return [e for p in self.paths for e in p.errors]
+
+    @property
+    def clean(self) -> bool:
+        return not self.unwaived and not self.errors
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "paths": [p.to_dict() for p in self.paths],
+            "unwaived": [f.to_dict() for f in self.unwaived],
+            "waived": [{"finding": f.to_dict(), "waiver": w.to_dict()}
+                       for f, w in self.waived],
+            "stale_waivers": [w.to_dict() for w in self.stale_waivers],
+            "errors": self.errors,
+            "lint_config": self.config.to_dict(),
+            "pass": self.clean,
+        }
+
+
+__all__ = ["LintFinding", "Waiver", "load_waivers", "apply_waivers",
+           "LintConfig", "LintContext", "PathResult", "LintReport",
+           "SEVERITY_ERROR", "SEVERITY_WARN"]

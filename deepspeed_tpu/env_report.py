@@ -8,6 +8,8 @@ compiled/compatible op table).
 from __future__ import annotations
 
 import importlib
+import json
+import os
 import sys
 
 GREEN_OK = "\033[92m[OKAY]\033[0m"
@@ -102,6 +104,58 @@ def software_report(lines=None) -> list:
     return out
 
 
+def find_lint_audit(path: str = None) -> str:
+    """LINT_AUDIT.json location: explicit arg > $DS_LINT_AUDIT > cwd >
+    the repo root this package sits in. An explicitly requested file
+    that is missing is NOT silently replaced by a fallback — answering
+    "did the compiled programs change under me" from a stale artifact
+    is worse than answering not at all. Empty string when absent."""
+    explicit = path or os.environ.get("DS_LINT_AUDIT")
+    if explicit:
+        return explicit if os.path.isfile(explicit) else ""
+    candidates = [os.path.join(os.getcwd(), "LINT_AUDIT.json"),
+                  os.path.join(os.path.dirname(
+                      os.path.dirname(os.path.abspath(__file__))),
+                      "LINT_AUDIT.json")]
+    for c in candidates:
+        if os.path.isfile(c):
+            return c
+    return ""
+
+
+def lint_report(lines=None, path: str = None) -> list:
+    """One-line static-lint summary when a LINT_AUDIT.json is present
+    (tools/ds_lint.py over the flagship configs): configs passed, waived
+    count, and the newest finding — the operator's 10-second answer to
+    "did the compiled programs change under me"."""
+    out = lines if lines is not None else []
+    fp = find_lint_audit(path)
+    if not fp:
+        explicit = path or os.environ.get("DS_LINT_AUDIT")
+        if explicit:
+            out.append(f"static lint: requested audit missing: {explicit}")
+        return out
+    try:
+        with open(fp) as f:
+            rec = json.load(f)
+        configs = rec.get("configs", {})
+        passed = sum(1 for c in configs.values() if c.get("pass"))
+        findings = [f for c in configs.values()
+                    for f in c.get("findings", [])]
+        unwaived = sum(len(c.get("unwaived", [])) for c in configs.values())
+        waived = len(rec.get("waived", []))
+        newest = findings[-1]["fingerprint"] if findings else "none"
+        status = GREEN_OK if rec.get("all_pass") else RED_NO
+        out.append("-" * 64)
+        out.append(
+            f"static lint {status} {passed}/{len(configs)} configs pass, "
+            f"{len(findings)} finding(s) ({waived} waived, "
+            f"{unwaived} unwaived); newest: {newest}")
+    except Exception as e:  # a corrupt artifact must not kill ds_report
+        out.append(f"static lint: unreadable {fp}: {e}")
+    return out
+
+
 def main() -> int:
     lines: list = []
     lines.append("=" * 64)
@@ -110,6 +164,7 @@ def main() -> int:
     software_report(lines)
     device_report(lines)
     op_report(lines)
+    lint_report(lines)
     print("\n".join(lines))
     return 0
 

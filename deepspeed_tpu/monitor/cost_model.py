@@ -64,6 +64,13 @@ def abstract_leaf(x: Any) -> Any:
     if shape is None or dtype is None:
         return x
     sharding = getattr(x, "sharding", None)
+    # An UNCOMMITTED array's SingleDeviceSharding is placement history,
+    # not a constraint — mirroring it would pin the AOT lower to that one
+    # device and clash with mesh-sharded siblings ("incompatible devices
+    # for jitted computation" on the offload grad path, whose rng rides
+    # along uncommitted). Drop it; jax re-defaults placement at lower.
+    if isinstance(sharding, jax.sharding.SingleDeviceSharding):
+        sharding = None
     try:
         return jax.ShapeDtypeStruct(shape, dtype, sharding=sharding)
     except Exception:
@@ -239,11 +246,9 @@ def build_cost_model(sentinel, comm_bytes_by_path: Dict[str, float],
     peaks = peaks or chip_peaks()
     t_build0 = time.perf_counter()
     paths: Dict[str, Dict[str, Any]] = {}
-    sources: Dict[str, Tuple] = {}
-    for name, st in getattr(sentinel, "_fns", {}).items():
-        fn, ab = st.get("fn"), st.get("abstract_args")
-        if fn is not None and ab is not None:
-            sources[name] = (fn, ab[0], ab[1])
+    # The sentinel's formal registry handoff (shared with the lint
+    # auditor).
+    sources: Dict[str, Tuple] = dict(sentinel.registered_paths())
     for name, triple in (extra_paths or {}).items():
         sources.setdefault(name, triple)
     for name, (fn, a_args, a_kwargs) in sources.items():

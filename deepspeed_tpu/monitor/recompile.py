@@ -107,6 +107,21 @@ class RecompileSentinel:
     def compile_counts(self) -> Dict[str, int]:
         return {name: st["compiles"] for name, st in self._fns.items()}
 
+    def registered_paths(self) -> Dict[str, Tuple[Callable, Tuple, Dict]]:
+        """The registry handoff: {path name: (raw jitted fn, abstract
+        args, abstract kwargs)} for every instrumented function that has
+        compiled at least once. The abstract signature is the one
+        recorded at the LAST compile (ShapeDtypeStructs with shardings —
+        they survive buffer donation), so consumers (the roofline cost
+        model, the analysis/ lint auditor) can AOT re-lower each path
+        host-side with zero device traffic and zero fences."""
+        out: Dict[str, Tuple[Callable, Tuple, Dict]] = {}
+        for name, st in self._fns.items():
+            fn, ab = st.get("fn"), st.get("abstract_args")
+            if fn is not None and ab is not None:
+                out[name] = (fn, ab[0], ab[1])
+        return out
+
     def instrument(self, name: str, fn: Callable) -> Callable:
         """Wrap ``fn`` (typically a jitted callable). The wrapper preserves
         call/donation semantics; the raw function stays reachable via

@@ -31,6 +31,13 @@ This module turns the schedule from prose into a checkable artifact:
 Everything here is static analysis of ``jit(...).lower(...).compile()``
 output — no step is executed, so auditing a multi-GB config costs only a
 compile.
+
+The generic HLO-text mechanics (computation splitting, shape sizing,
+loop attribution, trip counts) live in ``analysis/hlo_text.py`` — the
+shared parsing layer of the lint-pass framework (analysis/) — so the
+collective audit and the lint suite read compiled programs identically.
+This module keeps the COLLECTIVE-specific analysis: replica groups, the
+ring wire model, the ZeRO-2 lowering probe, and the grad-sync pricing.
 """
 from __future__ import annotations
 
@@ -38,66 +45,26 @@ import dataclasses
 import re
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ..analysis.hlo_text import (
+    DTYPE_BYTES, INSTR_RE as _INSTR_RE,
+    parse_shape_bytes as _parse_shapes,
+    split_computations as _split_computations,
+    loop_computations as _loop_computations,
+    while_trip_counts)
+
 __all__ = [
     "CollectiveOp", "CommAudit", "parse_hlo_collectives", "audit_text",
     "audit_jit", "ring_wire_bytes", "zero2_grad_sync_lowering",
-    "grad_sync_wire_model",
+    "grad_sync_wire_model", "DTYPE_BYTES", "while_trip_counts",
 ]
-
-# Bytes per element for the HLO primitive types that can appear in
-# collective shapes. (f8 variants share one entry per byte width.)
-DTYPE_BYTES: Dict[str, int] = {
-    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1, "f8e4m3b11fnuz": 1,
-    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
-    "s32": 4, "u32": 4, "f32": 4,
-    "s64": 8, "u64": 8, "f64": 8, "c64": 8,
-    "c128": 16,
-}
 
 COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
                     "collective-permute", "all-to-all")
 
-# `%name = <shape> <opcode>(<operands>), attr=..., ...` — async collectives
-# appear as `<opcode>-start`; the matching `-done` carries no new traffic.
-_INSTR_RE = re.compile(
-    r"^\s*(?:ROOT\s+)?%(?P<name>[\w.\-]+)\s*=\s*"
-    r"(?P<shape>\([^=]*?\)|[a-z0-9]+\[[^\]]*\](?:\{[^}]*\})?)\s+"
-    r"(?P<op>[a-z\-]+(?:-start)?)\(")
-_SHAPE_RE = re.compile(r"([a-z][a-z0-9]*)\[([0-9,]*)\]")
-_COMP_RE = re.compile(r"^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*->.*\{\s*$")
-_BODY_RE = re.compile(r"body=%([\w.\-]+)")
-_CALLEE_RE = re.compile(
-    r"(?:calls|to_apply|condition|body|branch_computations)="
-    r"(?:\{)?%([\w.\-]+(?:,\s*%[\w.\-]+)*)")
 _IOTA_GROUPS_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]<=\[")
 _LIST_GROUPS_RE = re.compile(r"replica_groups=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
 _PAIRS_RE = re.compile(r"source_target_pairs=\{(\{[^}]*\}(?:,\{[^}]*\})*)\}")
 _OPNAME_RE = re.compile(r'op_name="([^"]*)"')
-
-
-def _parse_shapes(shape_str: str, largest_only: bool = False
-                  ) -> Tuple[int, List[str]]:
-    """Total bytes + the individual `dtype[dims]` strings of a (possibly
-    tuple) HLO shape. Layout annotations (`{1,0}`) are ignored.
-
-    ``largest_only``: return the LARGEST component's bytes instead of the
-    sum — for async ``-start`` results, whose tuple aliases the input
-    buffer alongside the output (plus u32 context scalars), summing would
-    double-count the payload. Variadic (non-async) tuple collectives sum.
-    """
-    shapes, total, largest = [], 0, 0
-    for dtype, dims in _SHAPE_RE.findall(shape_str):
-        if dtype not in DTYPE_BYTES:
-            continue    # token types (after-all etc.) carry no payload
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        nbytes = n * DTYPE_BYTES[dtype]
-        total += nbytes
-        largest = max(largest, nbytes)
-        shapes.append(f"{dtype}[{dims}]")
-    return (largest if largest_only else total), shapes
 
 
 def ring_wire_bytes(kind: str, payload_bytes: int, group_size: int) -> int:
@@ -159,32 +126,6 @@ class CollectiveOp:
         return d
 
 
-def _loop_computations(comp_lines: Dict[str, List[str]]) -> set:
-    """Computation names reachable from any ``while`` body — collectives
-    there run once per trip count. Follows calls/branches transitively so
-    a collective inside a ``lax.cond`` inside a scan is still loop-tagged."""
-    callees: Dict[str, set] = {}
-    roots: set = set()
-    for name, lines in comp_lines.items():
-        refs: set = set()
-        for line in lines:
-            for mm in _CALLEE_RE.finditer(line):
-                for ref in mm.group(1).split(","):
-                    refs.add(ref.strip().lstrip("%"))
-            bm = _BODY_RE.search(line)
-            if bm and " while(" in line:
-                roots.add(bm.group(1))
-        callees[name] = refs
-    reach, frontier = set(), set(roots)
-    while frontier:
-        c = frontier.pop()
-        if c in reach:
-            continue
-        reach.add(c)
-        frontier |= callees.get(c, set())
-    return reach
-
-
 def parse_hlo_collectives(hlo_text: str) -> List[CollectiveOp]:
     """Extract every collective instruction from optimized-HLO text.
 
@@ -192,19 +133,9 @@ def parse_hlo_collectives(hlo_text: str) -> List[CollectiveOp]:
     and the iota form `[G,g]<=[N]`), tuple-shaped variadic collectives,
     and async `-start`/`-done` pairs (only `-start` is counted). Each op
     records its enclosing computation and whether that computation is
-    (transitively) a while-loop body."""
-    comp_lines: Dict[str, List[str]] = {}
-    computation = ""
-    for line in hlo_text.splitlines():
-        comp = _COMP_RE.match(line)
-        # Header lines are `%name (params) -> result {`; instruction lines
-        # always contain an ` = ` assignment (a bare `=` check would
-        # misfire on the `/*index=N*/` markers in long tuple params).
-        if comp and " = " not in line:
-            computation = comp.group(1)
-            comp_lines.setdefault(computation, [])
-            continue
-        comp_lines.setdefault(computation, []).append(line)
+    (transitively) a while-loop body. (Computation splitting and loop
+    attribution come from the shared analysis/hlo_text layer.)"""
+    comp_lines = _split_computations(hlo_text)
     loop_comps = _loop_computations(comp_lines)
 
     ops: List[CollectiveOp] = []
@@ -261,37 +192,6 @@ def parse_hlo_collectives(hlo_text: str) -> List[CollectiveOp]:
                 op_name=om.group(1) if om else "",
                 in_loop=computation in loop_comps))
     return ops
-
-
-_CONST_RE = re.compile(r"constant\((\d+)\)")
-_COND_RE = re.compile(r"condition=%([\w.\-]+)")
-
-
-def while_trip_counts(hlo_text: str) -> List[int]:
-    """Best-effort static trip counts: the integer constants appearing in
-    each ``while`` instruction's CONDITION computation (a ``lax.scan``'s
-    bound compiles to ``compare(i, constant(T)), direction=LT``). Returns
-    every candidate, largest first — callers check membership of the
-    analytic count rather than assuming a unique bound."""
-    comp_lines: Dict[str, List[str]] = {}
-    computation = ""
-    conds: List[str] = []
-    for line in hlo_text.splitlines():
-        comp = _COMP_RE.match(line)
-        if comp and " = " not in line:
-            computation = comp.group(1)
-            comp_lines.setdefault(computation, [])
-            continue
-        comp_lines.setdefault(computation, []).append(line)
-        if " while(" in line:
-            cm = _COND_RE.search(line)
-            if cm:
-                conds.append(cm.group(1))
-    counts: List[int] = []
-    for cond in conds:
-        for line in comp_lines.get(cond, []):
-            counts.extend(int(c) for c in _CONST_RE.findall(line))
-    return sorted(set(counts), reverse=True)
 
 
 @dataclasses.dataclass

@@ -175,6 +175,21 @@ class PipelineEngine(DeepSpeedEngine):
             section["top_modules"] = train["top_modules"]
         return {"pipeline": section}
 
+    def _lint_path_meta(self, name):
+        """Pipeline provenance for the lint auditor: the pipelined
+        train_step IS the registered path (all ticks compile into the one
+        scan+ppermute program), so tag it with the schedule shape the
+        report reader needs to attribute per-tick boundary permutes."""
+        meta = super()._lint_path_meta(name)
+        if self._pipe_spec is not None:
+            meta["pipeline"] = {
+                "schedule": (self.telemetry.meta.get("pipeline") or
+                             {}).get("schedule"),
+                "stages": int(self.mesh.shape.get("pipe", 1)),
+                "micro_batches": self._num_micro,
+            }
+        return meta
+
     @staticmethod
     def _peek_param_dict(config):
         """Normalize any accepted config form to its raw param dict, for

@@ -427,3 +427,75 @@ class Test1F1BPermuteBytes:
         # be a tautology: ticks would cancel).
         assert ticks in audit.while_trip_counts(), \
             (ticks, audit.while_trip_counts())
+
+
+# ------------------------------------------------------------------ #
+# All-to-all: parsing + wire-model pricing on a synthetic MoE dispatch
+# ------------------------------------------------------------------ #
+class TestAllToAllDispatch:
+    """hlo_audit parses all-to-all but, pre-MoE, nothing in the engine
+    emits one — this synthetic shard_map dispatch keeps the parser and
+    the wire model tested ground for ROADMAP item 4 (expert-parallel
+    all-to-all dispatch/combine)."""
+
+    E, C, H = 8, 4, 16          # experts (= dp ranks), capacity, hidden
+
+    def _audit(self, mesh8):
+        from deepspeed_tpu.parallel import comm
+
+        def dispatch(x):        # per-rank expert blocks [E, C, H]
+            return comm.all_to_all(x, "data", split_axis=0, concat_axis=0)
+
+        fn = comm.shard_map(dispatch, mesh=mesh8, in_specs=(P("data"),),
+                            out_specs=P("data"), check_vma=False)
+        x = jnp.ones((self.E * self.E, self.C, self.H), jnp.float32)
+        return hlo_audit.audit_jit(jax.jit(fn), x)
+
+    def test_parses_variadic_all_to_all(self, mesh8):
+        """XLA lowers the tiled all_to_all to ONE variadic instruction
+        whose 8-way operand/result tuples carry `/*index=N*/` comments —
+        the tuple form the shared INSTR_RE must survive (a `[^=]*`-style
+        shape alternative dies on the `=` inside the comment)."""
+        a2a = self._audit(mesh8).of_kind("all-to-all")
+        assert len(a2a) == 1, self._audit(mesh8).summary()
+        op = a2a[0]
+        assert op.group_size == 8 and op.num_groups == 1
+        assert len(op.in_shapes) == self.E
+        assert set(op.in_shapes) == {f"f32[1,{self.C},{self.H}]"}
+        assert op.out_shapes == op.in_shapes
+        assert not op.in_loop
+        assert "all_to_all" in op.op_name
+
+    def test_wire_model_prices_full_block(self, mesh8):
+        """Ring pricing over the FULL per-device block B = E*C*H*4:
+        each rank keeps its own 1/E slice, so (g-1)/g x B crosses the
+        wire — the MoE dispatch budget ROADMAP item 4 will be gated on."""
+        op = self._audit(mesh8).of_kind("all-to-all")[0]
+        full = self.E * self.C * self.H * 4
+        assert op.payload_bytes == full
+        assert op.wire_bytes == hlo_audit.ring_wire_bytes(
+            "all-to-all", full, 8)
+        assert op.wire_bytes == (8 - 1) * full // 8
+
+
+class TestNestedTupleAsync:
+    def test_nested_tuple_async_variadic_parses(self):
+        """XLA's all-gather combiner merges per-leaf gathers into ONE
+        variadic async op whose -start result wraps operand/result
+        tuples in an outer pair — the shared INSTR_RE must allow that
+        one nesting level (a flat `[^()]*` tuple alternative drops the
+        collective from the audit entirely)."""
+        synth = """
+HloModule m
+
+ENTRY %main (a: f32[128], b: f32[64]) -> f32[192] {
+  %ag-start = ((f32[128]{0}, f32[64]{0}), (f32[1024]{0}, f32[512]{0})) all-gather-start(f32[128]{0} %a, f32[64]{0} %b), channel_id=9, replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}
+  ROOT %done = f32[192]{0} bitcast(f32[128]{0} %a)
+}
+"""
+        ops = hlo_audit.parse_hlo_collectives(synth)
+        assert len(ops) == 1, ops
+        op = ops[0]
+        assert op.kind == "all-gather" and op.group_size == 8
+        assert op.out_bytes == 1024 * 4     # largest nested component
+        assert op.in_bytes == (128 + 64) * 4

@@ -1,11 +1,23 @@
 #!/usr/bin/env bash
 # Tier-1 verify — the ROADMAP.md command, verbatim. Green = safe to ship.
-# Opt-in: --bench-gate (or BENCH_GATE=1) additionally diffs the latest
-# two bench rounds' MFU/goodput via tools/bench_gate.py and fails on
-# regression beyond threshold.
+# Opt-ins (same pattern, composable):
+#   --bench-gate / BENCH_GATE=1 : diff the latest two bench rounds'
+#       MFU/goodput via tools/bench_gate.py, fail on regression.
+#   --lint / LINT_GATE=1 : run tools/ds_lint.py --check over the flagship
+#       configs — fail on any unwaived finding OR stale waiver
+#       (tools/lint_waivers.json is the baseline).
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
-if [ "${1:-}" = "--bench-gate" ] || [ "${BENCH_GATE:-0}" = "1" ]; then
+for arg in "$@"; do
+  case "$arg" in
+    --bench-gate) BENCH_GATE=1 ;;
+    --lint) LINT_GATE=1 ;;
+  esac
+done
+if [ "${BENCH_GATE:-0}" = "1" ]; then
   python tools/bench_gate.py || rc=1
+fi
+if [ "${LINT_GATE:-0}" = "1" ]; then
+  python tools/ds_lint.py --check || rc=1
 fi
 exit $rc
