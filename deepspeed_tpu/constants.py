@@ -209,6 +209,40 @@ TELEMETRY_COST_MODEL = "cost_model"
 TELEMETRY_COST_MODEL_DEFAULT = True
 
 #############################################
+# Inference / serving (inference/ subsystem)
+#############################################
+# The "inference" block configures the batched autoregressive serving
+# tier (deepspeed_tpu/inference/): the slot count of the static KV
+# cache, the cache sequence capacity, weight quantization, and the
+# prefill chunking. All of it is STATIC program shape — the continuous-
+# batching scheduler inserts/evicts requests without changing any
+# compiled signature (the recompile sentinel is the regression gate).
+INFERENCE = "inference"
+# Number of concurrent request slots in the KV cache. Must be divisible
+# by the mesh dp-axis size (slots are the data-parallel dimension of
+# serving).
+INFERENCE_MAX_SLOTS = "max_slots"
+INFERENCE_MAX_SLOTS_DEFAULT = 8
+# KV-cache sequence capacity per slot; 0 = the model's max_seq_length.
+INFERENCE_MAX_SEQ_LEN = "max_seq_len"
+INFERENCE_MAX_SEQ_LEN_DEFAULT = 0
+# Weight quantization applied at engine construction: "none" keeps the
+# checkpoint dtype, "bf16" stochastically rounds fp32 weights to bf16
+# (ops/stochastic_rounding.py — the master-free training machinery),
+# "int8" stores per-output-channel symmetric int8 (stochastic rounding
+# onto the integer grid) and dequantizes inside the compiled step.
+INFERENCE_QUANTIZE = "quantize"
+INFERENCE_QUANTIZE_DEFAULT = "none"
+INFERENCE_QUANTIZE_MODES = ("none", "bf16", "int8")
+# Prefill chunk length: prompts are right-padded to a multiple and run
+# chunk-by-chunk against the cache (static shapes at every prompt
+# length). 0 = whole-prompt single-shot prefill padded to max_seq_len —
+# the long-context path that composes with ring attention when the mesh
+# has a sequence axis.
+INFERENCE_PREFILL_CHUNK = "prefill_chunk"
+INFERENCE_PREFILL_CHUNK_DEFAULT = 32
+
+#############################################
 # ZeRO
 #############################################
 ZERO_OPTIMIZATION = "zero_optimization"

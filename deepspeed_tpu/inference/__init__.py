@@ -1,0 +1,25 @@
+"""inference/ — the batched autoregressive serving tier.
+
+The training side of this framework ends at a checkpoint; this package
+is what stands between that checkpoint and heavy traffic: a slot-major
+KV cache born sharded over the training mesh (kv_cache.py), jitted
+single-token decode + chunked/whole-prompt prefill over the GPT-2 family
+(decode.py), iteration-level continuous batching with an open-loop
+request queue (scheduler.py), weight quantization via the stochastic-
+rounding machinery (quantize.py), and the InferenceEngine tying it to
+the telemetry spine — decode-step JSONL records, prefill spans, the
+recompile sentinel over both compiled paths, and per-request
+TTFT/TPOT/occupancy goodput (engine.py). See
+docs/tutorials/inference.md.
+"""
+from .engine import InferenceEngine
+from .kv_cache import KVCacheSpec, cache_partition_spec, init_cache
+from .quantize import dequantize, quantize_params
+from .scheduler import (ContinuousBatchingScheduler, Request,
+                        synthetic_requests)
+
+__all__ = [
+    "InferenceEngine", "KVCacheSpec", "cache_partition_spec",
+    "init_cache", "quantize_params", "dequantize",
+    "Request", "synthetic_requests", "ContinuousBatchingScheduler",
+]

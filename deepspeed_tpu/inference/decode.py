@@ -1,0 +1,249 @@
+"""Incremental GPT-2 forward paths: single-token decode and chunked /
+whole-prompt prefill against the slot-major KV cache.
+
+Three compiled programs make up the serving data plane, each with a
+FIXED abstract signature (the recompile sentinel wraps all of them):
+
+- ``gpt2_decode``: one token per slot, for every slot at once. Attends
+  against the cache only, computes LAST-position logits only (via the
+  same tied-unembedding contraction ``models.gpt2.gpt2_logits_at``
+  exposes for the batch path), and samples in-graph with a threaded
+  PRNG. Slots are independent along the leading axis, so GSPMD
+  partitions the step over the data axis without touching another
+  slot's cache.
+- ``gpt2_prefill_chunk``: one prompt chunk for ONE slot. Writes the
+  chunk's K/V into the slot via ``dynamic_update_slice`` and attends
+  against the slot's full cache row (prefix + the chunk itself) under a
+  global-position causal mask — so any chunk length divides any prompt
+  without shape polymorphism. Prefill and decode are separate programs
+  on purpose (prefill/decode disaggregation): a long admission never
+  changes the decode signature.
+- ``gpt2_prefill_full``: the whole (padded) prompt in one shot through
+  the standard block math with a pluggable ``attention_fn`` — this is
+  where ring attention plugs in for long-context prefill when the mesh
+  has a sequence axis (``ops/ring_attention.ring_attention_fn``).
+
+All block math mirrors ``models/transformer.transformer_block`` for the
+deterministic pre-LN case (fp32 softmax, compute-dtype matmuls, same
+mask constant), so decode logits match ``gpt2_apply``'s final position
+to float tolerance — asserted per step in tests/test_inference.py.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import kv_cache
+from ..models.gpt2 import GPT2Config
+from ..models.transformer import dense, layer_norm
+
+NEG_INF = jnp.float32(-1e9)    # same masking constant as dense_attention
+
+
+def _check_cfg(cfg: GPT2Config) -> None:
+    if not cfg.pre_layer_norm or not cfg.causal:
+        raise NotImplementedError(
+            "the incremental decode path implements the GPT-2 block "
+            "(pre-LN, causal); post-LN/bidirectional models have no "
+            "autoregressive serving story")
+
+
+def _ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: GPT2Config
+         ) -> jax.Array:
+    h = layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
+    h = dense(h, p["fc_kernel"], p["fc_bias"])
+    h = jax.nn.gelu(h, approximate=not cfg.gelu_exact)
+    h = dense(h, p["fc_out_kernel"], p["fc_out_bias"])
+    return x + h
+
+
+def _qkv(p: Dict[str, jax.Array], x: jax.Array, cfg: GPT2Config
+         ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """ln1 + QKV projection; x [..., H] → q,k,v [..., nH, dH]."""
+    h = layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
+    qkv = dense(h, p["qkv_kernel"], p["qkv_bias"])
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split = x.shape[:-1] + (cfg.num_heads, cfg.head_dim)
+    return q.reshape(split), k.reshape(split), v.reshape(split)
+
+
+# --------------------------------------------------------------------- #
+# Decode: one token per slot, all slots at once
+# --------------------------------------------------------------------- #
+def _decode_block(p, x, kc, vc, lengths, cfg: GPT2Config):
+    """x [S, H]; kc/vc [S, nH, T, D]; lengths [S]. Returns (x', kc', vc').
+
+    The current token sits at position lengths[s]: its K/V are written
+    first, then attention runs over positions 0..lengths[s] inclusive —
+    exactly the causal row the full forward computes at that position.
+    """
+    S, H = x.shape
+    q, k, v = _qkv(p, x, cfg)                       # [S, nH, D] each
+    kc = kv_cache.write_token(kc, k, lengths)
+    vc = kv_cache.write_token(vc, v, lengths)
+    s = jnp.einsum("snd,sntd->snt", q, kc).astype(jnp.float32)
+    s = s / math.sqrt(cfg.head_dim)
+    mask = kv_cache.length_mask(lengths, kc.shape[2])   # [S, T]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("snt,sntd->snd", w.astype(vc.dtype), vc)
+    attn = attn.reshape(S, H).astype(x.dtype)
+    x = x + dense(attn, p["proj_kernel"], p["proj_bias"])
+    return _ffn(p, x, cfg), kc, vc
+
+
+def gpt2_decode(params: Dict[str, Any], kc: jax.Array, vc: jax.Array,
+                tokens: jax.Array, lengths: jax.Array, cfg: GPT2Config
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One decode step for every slot: tokens/lengths [S] → (logits
+    [S, V] fp32, kc', vc'). The caller advances lengths for the slots it
+    considers active; position = lengths[s] by construction."""
+    _check_cfg(cfg)
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[lengths]
+
+    def body(h, layer):
+        p, kcl, vcl = layer
+        h, kcl, vcl = _decode_block(p, h, kcl, vcl, lengths, cfg)
+        return h, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                   cfg.layer_norm_eps)
+    logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    return logits, kc, vc
+
+
+# --------------------------------------------------------------------- #
+# Chunked prefill: one chunk of one slot's prompt
+# --------------------------------------------------------------------- #
+def _prefill_block(p, x, kc, vc, slot, start, cfg: GPT2Config):
+    """x [C, H]; writes the chunk's K/V at (slot, start) then attends
+    the chunk against the slot's whole cache row under the global causal
+    mask (col <= start + row)."""
+    C, H = x.shape
+    q, k, v = _qkv(p, x, cfg)                       # [C, nH, D]
+    kc = kv_cache.write_chunk(kc, k, slot, start)
+    vc = kv_cache.write_chunk(vc, v, slot, start)
+    krow = kv_cache.slot_rows(kc, slot)             # [nH, T, D]
+    vrow = kv_cache.slot_rows(vc, slot)
+    s = jnp.einsum("cnd,ntd->nct", q, krow).astype(jnp.float32)
+    s = s / math.sqrt(cfg.head_dim)
+    T = krow.shape[1]
+    rows = start + lax.broadcasted_iota(jnp.int32, (C, 1), 0)
+    cols = lax.broadcasted_iota(jnp.int32, (1, T), 1)
+    s = jnp.where((cols <= rows)[None], s, NEG_INF)
+    w = jax.nn.softmax(s, axis=-1)
+    attn = jnp.einsum("nct,ntd->cnd", w.astype(vrow.dtype), vrow)
+    attn = attn.reshape(C, H).astype(x.dtype)
+    x = x + dense(attn, p["proj_kernel"], p["proj_bias"])
+    return _ffn(p, x, cfg), kc, vc
+
+
+def gpt2_prefill_chunk(params: Dict[str, Any], kc: jax.Array,
+                       vc: jax.Array, tokens: jax.Array, slot: jax.Array,
+                       start: jax.Array, last_idx: jax.Array,
+                       cfg: GPT2Config
+                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Run one prompt chunk (tokens [C]) for one slot. Returns (logits
+    [V] fp32 at chunk position ``last_idx``, kc', vc').
+
+    Only ONE position projects through the unembedding (the
+    gpt2_logits_at memory contract: never a [C, vocab] tensor) — the
+    scheduler uses it on the final chunk to sample the first token;
+    earlier chunks compute it too (uniform program) and discard it.
+    Padding rows beyond the prompt inside the final chunk produce
+    garbage that nothing reads: causal masking keeps them out of every
+    real row, and the next token's decode write overwrites their cache
+    rows before any attend reaches them.
+    """
+    _check_cfg(cfg)
+    C = tokens.shape[0]
+    pos = start + jnp.arange(C, dtype=jnp.int32)
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[pos]
+
+    def body(h, layer):
+        p, kcl, vcl = layer
+        h, kcl, vcl = _prefill_block(p, h, kcl, vcl, slot, start, cfg)
+        return h, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
+    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
+                   cfg.layer_norm_eps)
+    h_last = lax.dynamic_slice(x, (last_idx.astype(jnp.int32),
+                                   jnp.int32(0)), (1, x.shape[1]))[0]
+    logits = (h_last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    return logits, kc, vc
+
+
+# --------------------------------------------------------------------- #
+# Whole-prompt prefill (prefill_chunk: 0) — the long-context path
+# --------------------------------------------------------------------- #
+def gpt2_prefill_full(params: Dict[str, Any], kc: jax.Array,
+                      vc: jax.Array, tokens: jax.Array, slot: jax.Array,
+                      last_idx: jax.Array, cfg: GPT2Config,
+                      attention_fn: Optional[Callable] = None
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Single-shot prefill of one slot: tokens [T] padded to the cache's
+    max_len. The self-attention over the prompt runs through the
+    pluggable ``attention_fn`` — ring attention when the mesh has a
+    sequence axis (exact long-context prefill at 1/sp memory per chip),
+    the dense/flash default otherwise. Per-layer K/V come out of the
+    same scan as the hidden states and splice into the cache with one
+    dynamic_update_slice over all layers."""
+    _check_cfg(cfg)
+    if attention_fn is None:
+        from ..ops.flash_attention import auto_attention
+        attention_fn = auto_attention
+    T = tokens.shape[0]
+    x = (params["wte"].astype(cfg.dtype)[tokens] +
+         params["wpe"].astype(cfg.dtype)[:T])[None]        # [1, T, H]
+
+    def body(h, p):
+        q, k, v = _qkv(p, h, cfg)                  # [1, T, nH, D]
+        attn = attention_fn(q, k, v, mask=None, causal=True,
+                            deterministic=True)
+        attn = attn.reshape(h.shape).astype(h.dtype)
+        h = h + dense(attn, p["proj_kernel"], p["proj_bias"])
+        return _ffn(p, h, cfg), (k[0], v[0])       # ys: [T, nH, D]
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    # ks/vs [L, T, nH, D] → cache block [L, 1, nH, T, D] at slot.
+    zero = jnp.int32(0)
+    at = (zero, slot.astype(jnp.int32), zero, zero, zero)
+    kc = lax.dynamic_update_slice(
+        kc, ks.transpose(0, 2, 1, 3)[:, None].astype(kc.dtype), at)
+    vc = lax.dynamic_update_slice(
+        vc, vs.transpose(0, 2, 1, 3)[:, None].astype(vc.dtype), at)
+    x = layer_norm(x[0], params["ln_f_scale"], params["ln_f_bias"],
+                   cfg.layer_norm_eps)
+    h_last = lax.dynamic_slice(x, (last_idx.astype(jnp.int32),
+                                   jnp.int32(0)), (1, x.shape[1]))[0]
+    logits = (h_last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    return logits, kc, vc
+
+
+# --------------------------------------------------------------------- #
+# Sampling (in-graph; PRNG threaded by the engine per iteration)
+# --------------------------------------------------------------------- #
+def sample_tokens(logits: jax.Array, key: jax.Array,
+                  temperature: jax.Array) -> jax.Array:
+    """Greedy (temperature == 0) or temperature sampling; logits
+    [..., V] fp32. Temperature is a TRACED scalar so changing it never
+    recompiles; both branches are cheap relative to the step, so a
+    select beats a cond."""
+    greedy = jnp.argmax(logits, axis=-1)
+    t = jnp.maximum(temperature.astype(jnp.float32), 1e-6)
+    sampled = jax.random.categorical(key, logits / t, axis=-1)
+    return jnp.where(temperature > 0, sampled, greedy).astype(jnp.int32)
+
+
+__all__ = ["gpt2_decode", "gpt2_prefill_chunk", "gpt2_prefill_full",
+           "sample_tokens"]

@@ -1,0 +1,445 @@
+"""InferenceEngine — the serving-tier counterpart of the training
+engine: checkpoint/params in, continuously-batched tokens out.
+
+Architecture (mirrors the training engine's discipline):
+
+- TWO compiled programs serve everything: ``decode_step`` (one token for
+  every slot at once) and ``prefill_step`` (one chunk of one slot's
+  prompt — or the whole padded prompt when ``prefill_chunk: 0``). Both
+  have fixed abstract signatures for the lifetime of the engine and both
+  are wrapped by the recompile sentinel; ``fail_on_recompile`` turns any
+  post-warmup retrace into a hard error. Request admission, progress,
+  and eviction never touch a compiled shape.
+- The KV cache (inference/kv_cache.py) is born sharded: slots over the
+  mesh data axis, heads over the model axis. Its buffers are DONATED
+  through every step, so the cache exists once.
+- Host-side per-slot counters (lengths, active, last token) are the
+  scheduler's state; they enter each step as tiny int arrays. The one
+  device fetch per decode iteration is the sampled-token readback — the
+  inherent serving sync (the host must see tokens to detect EOS and
+  feed the next step), and it is the ONLY one.
+- Telemetry rides the training spine unchanged: per-iteration step
+  records (occupancy, active slots, fenced step wall), ``prefill``
+  spans, ``request_complete`` events, and the ``ServingAggregator``
+  snapshot (TTFT/TPOT p50/p95, tokens/s) in every drain's report
+  record. ``tools/telemetry_report.py`` turns the stream into the
+  ``serving`` section benches and CI diff.
+- Weight quantization (``inference.quantize``): bf16 via the stochastic
+  -rounding machinery, or int8-at-rest with in-step dequantize
+  (inference/quantize.py).
+"""
+from __future__ import annotations
+
+import os
+import time
+import weakref
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import decode as decode_mod
+from . import kv_cache
+from .quantize import dequantize, quantize_params, quantized_bytes
+from .. import constants as C
+from ..models.gpt2 import GPT2Config
+from ..monitor import Telemetry
+from ..monitor.memory import analytic_state_bytes
+from ..monitor.serving import ServingAggregator
+from ..parallel.topology import build_mesh, DP_AXIS, MP_AXIS, SP_AXIS
+from ..runtime.config import InferenceConfig, TelemetryConfig
+from ..runtime.config_utils import load_config_json
+from ..utils.logging import log_dist
+
+try:
+    from flax import serialization as flax_serialization
+except Exception:  # pragma: no cover
+    flax_serialization = None
+
+
+class InferenceEngine:
+    """Batched autoregressive serving over a device mesh."""
+
+    def __init__(self, model_cfg: GPT2Config, params: Any,
+                 config: Any = None, mesh: Optional[Mesh] = None,
+                 rng: Optional[jax.Array] = None,
+                 param_shardings: Any = None):
+        if isinstance(config, str):
+            config = load_config_json(config)
+        config = dict(config or {})
+        self.model_cfg = model_cfg
+        self.icfg = InferenceConfig(config)
+        self.tcfg = TelemetryConfig(config)
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.dp = int(self.mesh.shape.get(DP_AXIS, 1))
+        self.mp = int(self.mesh.shape.get(MP_AXIS, 1))
+        self.sp = int(self.mesh.shape.get(SP_AXIS, 1))
+
+        # --- static serving geometry (all of it compiled-program shape) ---
+        self.max_slots = int(self.icfg.max_slots)
+        self.max_len = int(self.icfg.max_seq_len) or \
+            int(model_cfg.max_seq_length)
+        if self.max_len > model_cfg.max_seq_length:
+            raise ValueError(
+                f"inference.max_seq_len={self.max_len} exceeds the model's "
+                f"position table ({model_cfg.max_seq_length})")
+        self.prefill_chunk = int(self.icfg.prefill_chunk)
+        if self.prefill_chunk > 0 and self.max_len % self.prefill_chunk:
+            raise ValueError(
+                f"inference.prefill_chunk={self.prefill_chunk} must divide "
+                f"the cache capacity ({self.max_len}) — padded prompts "
+                "would otherwise overrun the slot")
+        if self.prefill_chunk == 0 and self.sp > 1 \
+                and self.max_len % self.sp:
+            raise ValueError(
+                f"whole-prompt prefill with a seq axis needs max_seq_len "
+                f"({self.max_len}) divisible by sp={self.sp}")
+
+        # --- weights: quantize, then commit to the mesh ---
+        self.quantize = self.icfg.quantize
+        self._base_rng = rng if rng is not None else jax.random.PRNGKey(17)
+        if self.quantize != "none" and param_shardings is not None:
+            raise NotImplementedError(
+                "inference.quantize does not compose with tensor-parallel "
+                "param_shardings yet (quantized leaves change the tree "
+                "structure the specs address)")
+        params = quantize_params(params, self.quantize, self._base_rng)
+        if param_shardings is not None:
+            shardings = jax.tree_util.tree_map(
+                lambda spec: NamedSharding(self.mesh, spec),
+                param_shardings)
+        else:
+            shardings = NamedSharding(self.mesh, P())
+        self._params = jax.device_put(params, shardings)
+        self.param_bytes = quantized_bytes(self._params)
+
+        # --- the KV cache, born sharded ---
+        self.cache_spec = kv_cache.KVCacheSpec(
+            num_layers=model_cfg.num_layers, num_slots=self.max_slots,
+            num_heads=model_cfg.num_heads, max_len=self.max_len,
+            head_dim=model_cfg.head_dim, dtype=model_cfg.dtype)
+        self.cache = kv_cache.init_cache(self.cache_spec, self.mesh)
+        self._cache_sh = kv_cache.cache_shardings(self.mesh)
+
+        # --- host-authoritative per-slot counters ---
+        self.lengths = np.zeros(self.max_slots, np.int32)
+        self.active = np.zeros(self.max_slots, bool)
+        self.last_tokens = np.zeros(self.max_slots, np.int32)
+
+        # --- telemetry on the shared spine ---
+        self.iterations = 0
+        self._rng_calls = 0
+        self.serving = ServingAggregator(self.max_slots)
+        self.telemetry = Telemetry(
+            self.tcfg, default_report_steps=50,
+            meta=dict(mode="serving", model=model_cfg.name,
+                      dp=self.dp, mp=self.mp, sp=self.sp,
+                      max_slots=self.max_slots, max_seq_len=self.max_len,
+                      prefill_chunk=self.prefill_chunk,
+                      quantize=self.quantize,
+                      precision=jnp.dtype(model_cfg.dtype).name,
+                      param_bytes=self.param_bytes,
+                      kv_cache_bytes=self.cache_spec.nbytes()))
+        _ref = weakref.ref(self)
+        self.telemetry.step_provider = lambda: (
+            _ref().iterations if _ref() is not None else -1)
+        self.telemetry.set_analytic_footprint(analytic_state_bytes(
+            {"params": self._params, "cache": self.cache}))
+
+        # --- the two compiled paths (sentinel-instrumented) ---
+        self._decode_fn = self.telemetry.instrument_step_fn(
+            "decode_step", self._build_decode_step())
+        self._prefill_fn = self.telemetry.instrument_step_fn(
+            "prefill_step", self._build_prefill_step())
+
+        log_dist(
+            f"InferenceEngine initialized: {model_cfg.name}, "
+            f"slots={self.max_slots} (dp={self.dp}), "
+            f"cache={self.max_len}x{model_cfg.num_heads}h "
+            f"({self.cache_spec.nbytes() / 2 ** 20:.1f} MiB K+V), "
+            f"prefill={'full' if self.prefill_chunk == 0 else f'chunk {self.prefill_chunk}'}, "
+            f"quantize={self.quantize}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Compiled-path builders
+    # ------------------------------------------------------------------ #
+    def _runtime_params(self, params):
+        """Dequantize inside the compiled program (int8 at rest,
+        compute-dtype transients); identity for none/bf16."""
+        if self.quantize == "int8":
+            return dequantize(params, self.model_cfg.dtype)
+        return params
+
+    def _build_decode_step(self) -> Callable:
+        cfg = self.model_cfg
+
+        def decode_step(params, kc, vc, tokens, lengths, key, temperature):
+            p = self._runtime_params(params)
+            logits, kc, vc = decode_mod.gpt2_decode(p, kc, vc, tokens,
+                                                    lengths, cfg)
+            sampled = decode_mod.sample_tokens(logits, key, temperature)
+            return kc, vc, sampled, logits
+
+        sh = self._cache_sh
+        return jax.jit(decode_step, donate_argnums=(1, 2),
+                       out_shardings=(sh["k"], sh["v"], None, None))
+
+    def _build_prefill_step(self) -> Callable:
+        cfg = self.model_cfg
+        attention_fn = None
+        if self.prefill_chunk == 0 and self.sp > 1:
+            from ..ops.ring_attention import ring_attention_fn
+            attention_fn = ring_attention_fn(self.mesh)
+
+        def prefill_step(params, kc, vc, tokens, slot, start, last_idx,
+                         key, temperature):
+            p = self._runtime_params(params)
+            if self.prefill_chunk == 0:
+                logits, kc, vc = decode_mod.gpt2_prefill_full(
+                    p, kc, vc, tokens, slot, last_idx, cfg,
+                    attention_fn=attention_fn)
+            else:
+                logits, kc, vc = decode_mod.gpt2_prefill_chunk(
+                    p, kc, vc, tokens, slot, start, last_idx, cfg)
+            sampled = decode_mod.sample_tokens(logits, key, temperature)
+            return kc, vc, sampled, logits
+
+        sh = self._cache_sh
+        return jax.jit(prefill_step, donate_argnums=(1, 2),
+                       out_shardings=(sh["k"], sh["v"], None, None))
+
+    def _next_key(self) -> jax.Array:
+        self._rng_calls += 1
+        return jax.random.fold_in(self._base_rng, self._rng_calls)
+
+    # ------------------------------------------------------------------ #
+    # Slot lifecycle (host counters only — no device work)
+    # ------------------------------------------------------------------ #
+    def activate_slot(self, slot: int, context_len: int,
+                      last_token: int) -> None:
+        """Mark a freshly prefilled slot live: the cache holds positions
+        0..context_len-1 and ``last_token`` decodes at position
+        context_len next step."""
+        self.lengths[slot] = int(context_len)
+        self.active[slot] = True
+        self.last_tokens[slot] = int(last_token)
+
+    def release_slot(self, slot: int) -> None:
+        """Evict: counters clear; the stale cache rows are dead by
+        masking and get overwritten by the next occupant."""
+        self.active[slot] = False
+        self.lengths[slot] = 0
+        self.last_tokens[slot] = 0
+
+    def context_len(self, slot: int) -> int:
+        return int(self.lengths[slot])
+
+    @property
+    def active_slots(self) -> int:
+        return int(self.active.sum())
+
+    # ------------------------------------------------------------------ #
+    # The two serving operations
+    # ------------------------------------------------------------------ #
+    def prefill(self, prompt: Sequence[int], slot: int,
+                temperature: float = 0.0, return_logits: bool = False
+                ) -> Tuple[int, Optional[np.ndarray]]:
+        """Prefill one prompt into ``slot`` and sample its first output
+        token. Returns (token, final-position logits [V] when asked —
+        parity tests only; the serving loop needs just the token, and a
+        per-admission [V] fetch would be a wasted host transfer). The
+        caller activates the slot (scheduler owns admission ordering)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        plen = int(prompt.shape[0])
+        if plen < 1:
+            raise ValueError("empty prompt")
+        if plen >= self.max_len:
+            raise ValueError(
+                f"prompt length {plen} leaves no room to generate in a "
+                f"{self.max_len}-token slot")
+        kc, vc = self.cache["k"], self.cache["v"]
+        temp = np.float32(temperature)
+        if self.prefill_chunk == 0:
+            padded = np.zeros(self.max_len, np.int32)
+            padded[:plen] = prompt
+            kc, vc, tok, logits = self._prefill_fn(
+                self._params, kc, vc, padded, np.int32(slot),
+                np.int32(0), np.int32(plen - 1), self._next_key(), temp)
+        else:
+            chunk = self.prefill_chunk
+            n_chunks = -(-plen // chunk)
+            padded = np.zeros(n_chunks * chunk, np.int32)
+            padded[:plen] = prompt
+            tok = logits = None
+            for ci in range(n_chunks):
+                start = ci * chunk
+                last = ci == n_chunks - 1
+                last_idx = (plen - 1 - start) if last else 0
+                kc, vc, tok, logits = self._prefill_fn(
+                    self._params, kc, vc, padded[start:start + chunk],
+                    np.int32(slot), np.int32(start), np.int32(last_idx),
+                    self._next_key(), temp)
+        self.cache["k"], self.cache["v"] = kc, vc
+        self.telemetry.raise_pending()
+        out_logits = np.asarray(jax.device_get(logits)) \
+            if return_logits else None
+        return int(jax.device_get(tok)), out_logits
+
+    def decode_once(self, temperature: float = 0.0,
+                    return_logits: bool = False
+                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """One decode iteration for every slot (inactive slots compute
+        too — a uniform program is what keeps the signature fixed; their
+        counters just don't advance). Returns the sampled token per slot
+        (and the [S, V] logits when asked — tests only; the extra fetch
+        is not part of the serving loop)."""
+        t0 = time.perf_counter()
+        n_active = self.active_slots
+        kc, vc, sampled, logits = self._decode_fn(
+            self._params, self.cache["k"], self.cache["v"],
+            self.last_tokens, self.lengths, self._next_key(),
+            np.float32(temperature))
+        self.cache["k"], self.cache["v"] = kc, vc
+        self.telemetry.raise_pending()
+        # THE serving sync: the host needs the tokens (EOS detection +
+        # next step's inputs). One batched [S] fetch per iteration.
+        sampled = np.asarray(jax.device_get(sampled))
+        adv = self.active
+        self.lengths[adv] += 1
+        self.last_tokens[adv] = sampled[adv]
+        wall = time.perf_counter() - t0
+        self.iterations += 1
+        self.serving.note_iteration(n_active, wall)
+        tl = self.telemetry
+        if tl.enabled:
+            tl.record_step(self.iterations, {},
+                           wall_ms=wall * 1e3,
+                           active_slots=n_active,
+                           occupancy=round(n_active / self.max_slots, 4),
+                           tokens=n_active)
+            tl.maybe_drain(self.iterations, extra_fn=self._report_extra)
+        out_logits = np.asarray(jax.device_get(logits)) \
+            if return_logits else None
+        return sampled, out_logits
+
+    def _report_extra(self) -> Dict[str, Any]:
+        return {"serving": self.serving.snapshot()}
+
+    def complete_request(self, rid: Any, ttft_s: float,
+                         tpot_s: Optional[float], prompt_tokens: int,
+                         new_tokens: int) -> None:
+        """Per-request goodput accounting at completion (host clocks
+        only): feeds the aggregator and writes a ``request_complete``
+        telemetry event."""
+        self.serving.note_request(ttft_s, tpot_s, new_tokens)
+        if self.telemetry.enabled:
+            payload = {"rid": rid, "ttft_ms": round(ttft_s * 1e3, 3),
+                       "prompt_tokens": int(prompt_tokens),
+                       "new_tokens": int(new_tokens)}
+            if tpot_s is not None:
+                payload["tpot_ms"] = round(tpot_s * 1e3, 3)
+            self.telemetry.event("request_complete", payload)
+
+    def serve(self, requests, temperature: float = 0.0, **kwargs):
+        """Drive a request list/stream through the continuous-batching
+        scheduler; see inference/scheduler.py."""
+        from .scheduler import ContinuousBatchingScheduler
+        sched = ContinuousBatchingScheduler(self, temperature=temperature,
+                                            **kwargs)
+        return sched.serve(requests)
+
+    # ------------------------------------------------------------------ #
+    # Training-checkpoint handoff
+    # ------------------------------------------------------------------ #
+    @classmethod
+    def from_train_checkpoint(cls, load_dir: str, model_cfg: GPT2Config,
+                              config: Any = None, tag: Optional[str] = None,
+                              mesh: Optional[Mesh] = None,
+                              rng: Optional[jax.Array] = None,
+                              init_fn: Optional[Callable] = None
+                              ) -> "InferenceEngine":
+        """Build a serving engine from a training engine's checkpoint
+        directory (the ``latest``-pointer + ``mp_rank_00`` layout
+        runtime/engine.py saves). ``init_fn(rng, cfg) -> params``
+        defaults to ``models.gpt2.gpt2_init`` and is only used for its
+        tree STRUCTURE (eval_shape — no real init runs)."""
+        if flax_serialization is None:
+            raise RuntimeError("flax is required to read checkpoints")
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.isfile(latest):
+                raise FileNotFoundError(f"no 'latest' pointer in {load_dir}")
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, str(tag))
+        model_file = os.path.join(path, "mp_rank_00_model_states.msgpack")
+        if not os.path.isfile(model_file):
+            raise NotImplementedError(
+                f"{model_file} not found — TP-sharded (mp_rank_XX) "
+                "checkpoints need assembly, load them through the "
+                "training engine and pass raw params instead")
+        if init_fn is None:
+            from ..models.gpt2 import gpt2_init
+            init_fn = gpt2_init
+        template = jax.tree_util.tree_map(
+            lambda s: np.zeros(s.shape, s.dtype),
+            jax.eval_shape(lambda r: init_fn(r, model_cfg),
+                           jax.random.PRNGKey(0)))
+        with open(model_file, "rb") as f:
+            blob = flax_serialization.from_bytes({"module": template},
+                                                 f.read())
+        log_dist(f"serving from training checkpoint {path}", ranks=[0])
+        return cls(model_cfg, blob["module"], config=config, mesh=mesh,
+                   rng=rng)
+
+    # ------------------------------------------------------------------ #
+    # Static lint audit (analysis/) — duck-typed lint_engine contract
+    # ------------------------------------------------------------------ #
+    def _lint_path_meta(self, name: str) -> Dict[str, Any]:
+        """Pass metadata for the serving paths: no gradient sync exists
+        here, so collective_placement is inert; materialization scales
+        from the PER-DEVICE params+cache footprint (matching the
+        post-partitioning shapes in the compiled HLO), with the largest
+        per-device leaf exempt as usual."""
+        state = {"params": self._params, "cache": self.cache}
+        per_dev_leaves = []
+        for leaf in jax.tree_util.tree_leaves(state):
+            shape = getattr(leaf, "shape", None)
+            if shape is None:
+                continue
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is not None and hasattr(sharding, "shard_shape"):
+                try:
+                    shape = sharding.shard_shape(tuple(shape))
+                except Exception:
+                    pass
+            per_dev_leaves.append(
+                int(np.prod(shape)) * jnp.dtype(leaf.dtype).itemsize)
+        return {
+            "grad_sync_path": False,
+            "grad_sync_mode": "none",
+            "gas": 1,
+            "scatterable_leaf_bytes": [],
+            "declared_state_bytes": int(analytic_state_bytes(state)),
+            "param_bytes_full": int(self.param_bytes),
+            "largest_leaf_bytes": max(per_dev_leaves, default=0),
+            "dp": self.dp,
+            "zero_stage": 0,
+        }
+
+    def lint_audit(self, config=None, waivers=None, passes=None):
+        """Compile-time lint over the decode/prefill paths (host-side
+        AOT re-lower from the sentinel registry; zero device fences).
+        The serving contract: host_sync and materialization clean — no
+        full-cache gather, no in-step host transfer."""
+        from ..analysis.auditor import lint_engine
+        return lint_engine(self, config=config, waivers=waivers,
+                           passes=passes)
+
+    def close(self) -> None:
+        self.telemetry.close()
+
+
+__all__ = ["InferenceEngine"]

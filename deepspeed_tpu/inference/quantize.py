@@ -1,0 +1,106 @@
+"""Weight quantization for serving — built on the stochastic-rounding
+machinery the master-free training mode already ships.
+
+Two modes beyond "none":
+
+- ``bf16``: fp32 checkpoint weights stochastically rounded to bf16 via
+  ``ops/stochastic_rounding.tree_stochastic_round_bf16`` — the exact
+  add-noise-and-truncate bit trick the bf16 master-free optimizer uses,
+  reused verbatim. Unbiased (E[q] == w), halves weight HBM.
+- ``int8``: per-output-channel symmetric int8 for every >=2-D float
+  leaf, with the SAME unbiased rounding argument extended to the
+  integer grid: q = clip(floor(w/scale + u), -127, 127) with u~U[0,1)
+  makes E[q*scale] == w exactly (modulo clipping at the channel max,
+  where w/scale = ±127 lands on the grid). Scales are fp32, one per
+  output channel (last axis), so the tied-embedding matmul and the
+  embedding row gather dequantize consistently.
+
+Quantized leaves are stored as ``{"q": int8, "scale": f32}`` dicts in
+the param tree; ``dequantize`` collapses them back to compute-dtype
+arrays INSIDE the compiled decode/prefill programs — device HBM holds
+int8, the bf16 weights exist only as per-step transients. (Honest note:
+without a fused dequant-matmul kernel XLA materializes those transients,
+so the bandwidth win depends on fusion; the footprint win — 4x vs fp32
+weights at rest — is unconditional. A Pallas int8 matmul epilogue is the
+real-TPU follow-up.)
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.stochastic_rounding import tree_stochastic_round_bf16
+
+QUANT_KEY = "q"
+SCALE_KEY = "scale"
+
+
+def _is_quantized_leaf(x: Any) -> bool:
+    return isinstance(x, dict) and QUANT_KEY in x and SCALE_KEY in x
+
+
+def quantize_leaf_int8(w: jax.Array, key: jax.Array) -> Dict[str, jax.Array]:
+    """Per-output-channel (last axis) symmetric int8 with unbiased
+    stochastic rounding onto the integer grid."""
+    w32 = w.astype(jnp.float32)
+    reduce_axes = tuple(range(w32.ndim - 1))
+    amax = jnp.max(jnp.abs(w32), axis=reduce_axes, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-12)
+    u = jax.random.uniform(key, w32.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(w32 / scale + u), -127, 127).astype(jnp.int8)
+    return {QUANT_KEY: q, SCALE_KEY: scale.astype(jnp.float32)}
+
+
+def quantize_params(params: Any, mode: str,
+                    key: Optional[jax.Array] = None) -> Any:
+    """Quantize a param tree per the ``inference.quantize`` mode.
+
+    int8 targets every float leaf with ndim >= 2 (the matmul kernels and
+    embeddings — where the bytes are); vectors (LN scales, biases) stay
+    in their checkpoint dtype, they are noise in the footprint and load-
+    bearing in accuracy.
+    """
+    if mode == "none":
+        return params
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    if mode == "bf16":
+        return tree_stochastic_round_bf16(params, key)
+    if mode != "int8":
+        raise ValueError(f"unknown quantize mode {mode!r}")
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if hasattr(leaf, "dtype") and hasattr(leaf, "ndim") and \
+                leaf.ndim >= 2 and jnp.issubdtype(leaf.dtype, jnp.floating):
+            out.append(quantize_leaf_int8(leaf, jax.random.fold_in(key, i)))
+        else:
+            out.append(leaf)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def dequantize(params: Any, dtype: Any = jnp.bfloat16) -> Any:
+    """Collapse quantized {"q","scale"} leaves back to ``dtype`` arrays;
+    plain leaves pass through untouched. Called INSIDE the jitted
+    serving programs (int8 at rest, compute-dtype transients)."""
+    def deq(x):
+        if _is_quantized_leaf(x):
+            return (x[QUANT_KEY].astype(jnp.float32) *
+                    x[SCALE_KEY]).astype(dtype)
+        return x
+    return jax.tree_util.tree_map(deq, params, is_leaf=_is_quantized_leaf)
+
+
+def quantized_bytes(params: Any) -> int:
+    """At-rest bytes of a (possibly quantized) param tree."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(params):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += int(leaf.size) * jnp.dtype(leaf.dtype).itemsize
+    return total
+
+
+__all__ = ["quantize_params", "quantize_leaf_int8", "dequantize",
+           "quantized_bytes", "QUANT_KEY", "SCALE_KEY"]

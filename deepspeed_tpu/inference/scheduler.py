@@ -1,0 +1,206 @@
+"""Continuous batching: iteration-level scheduling over the slot cache.
+
+The Orca insight, host-side: the scheduler's unit of work is one decode
+ITERATION, not one request. Every iteration it (1) admits arrived
+requests into free slots (prefill runs as its own compiled program —
+prefill/decode disaggregation — and splices straight into the slot),
+(2) runs ONE decode step for every live slot, and (3) evicts the slots
+that finished. Requests join and leave mid-flight; the compiled decode
+program never notices, because admission and eviction are counter
+updates plus a dynamic_update_slice splice (inference/kv_cache.py).
+
+The arrival process is OPEN-LOOP: requests carry absolute arrival
+offsets and join the queue when the wall clock passes them, whether or
+not the engine has capacity — so TTFT honestly includes queue wait, and
+offered load above capacity shows up as a growing queue, not as a
+throttled arrival rate (the closed-loop benchmarking mistake).
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request in the open-loop stream."""
+    rid: int
+    prompt: np.ndarray                  # [P] int32 token ids
+    max_new_tokens: int = 16
+    arrival_s: float = 0.0              # offset from serve() start
+    # -- runtime state (scheduler-owned) --
+    slot: Optional[int] = None
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    t_arrival: float = 0.0              # absolute clock
+    t_first: Optional[float] = None     # first token produced (TTFT end)
+    t_last: Optional[float] = None      # latest token produced
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        return None if self.t_first is None \
+            else self.t_first - self.t_arrival
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        """Mean time per output token AFTER the first (the streaming
+        cadence a user sees); None for single-token responses."""
+        if self.t_first is None or self.t_last is None \
+                or len(self.out_tokens) < 2:
+            return None
+        return (self.t_last - self.t_first) / (len(self.out_tokens) - 1)
+
+
+def synthetic_requests(n: int, prompt_len: Tuple[int, int] = (8, 16),
+                       max_new_tokens: int = 16, rate_rps: float = 0.0,
+                       vocab_size: int = 512, seed: int = 0
+                       ) -> List[Request]:
+    """An open-loop synthetic arrival stream: ``rate_rps`` > 0 draws
+    exponential inter-arrival gaps (Poisson arrivals at that rate);
+    rate 0 = everything arrives at t=0 (the saturation stream the
+    occupancy acceptance gate uses). Prompts are uniform random tokens
+    with lengths in ``prompt_len`` (inclusive)."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    out = []
+    lo, hi = prompt_len
+    for i in range(n):
+        if rate_rps > 0 and i > 0:
+            t += float(rng.exponential(1.0 / rate_rps))
+        plen = int(rng.integers(lo, hi + 1))
+        prompt = rng.integers(0, vocab_size, size=plen).astype(np.int32)
+        out.append(Request(rid=i, prompt=prompt,
+                           max_new_tokens=max_new_tokens, arrival_s=t))
+    return out
+
+
+class ContinuousBatchingScheduler:
+    """Per-iteration insert/evict over an InferenceEngine's slots."""
+
+    def __init__(self, engine, temperature: float = 0.0,
+                 eos_token: Optional[int] = None,
+                 idle_sleep_s: float = 0.0005,
+                 max_wall_s: Optional[float] = None):
+        self.engine = engine
+        self.temperature = float(temperature)
+        self.eos_token = eos_token
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.max_wall_s = max_wall_s
+
+    # ------------------------------------------------------------------ #
+    def _finished(self, req: Request, slot_len: int) -> bool:
+        if len(req.out_tokens) >= req.max_new_tokens:
+            return True
+        if self.eos_token is not None and req.out_tokens and \
+                req.out_tokens[-1] == self.eos_token:
+            return True
+        # Slot full: the next decode would have nowhere to write.
+        return slot_len >= self.engine.max_len
+
+    def _complete(self, req: Request) -> None:
+        self.engine.complete_request(
+            req.rid, req.ttft_s or 0.0, req.tpot_s,
+            prompt_tokens=len(req.prompt),
+            new_tokens=len(req.out_tokens))
+
+    # ------------------------------------------------------------------ #
+    def serve(self, requests: Sequence[Request]) -> Dict[str, Any]:
+        """Run the stream to completion; returns the serving report
+        (the aggregator snapshot + per-request records)."""
+        eng = self.engine
+        t0 = time.perf_counter()
+        pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+        queue: deque = deque()
+        active: Dict[int, Request] = {}
+        free: deque = deque(i for i in range(eng.max_slots)
+                            if not eng.active[i])
+
+        while pending or queue or active:
+            now = time.perf_counter() - t0
+            if self.max_wall_s is not None and now > self.max_wall_s:
+                # Abandon the run WITHOUT leaking capacity: mid-flight
+                # slots must come back, or the engine's next serve()
+                # starts with no free slots and spins forever.
+                for slot in list(active):
+                    self.engine.release_slot(slot)
+                    free.append(slot)
+                    del active[slot]
+                break
+            # 1. open-loop arrivals join the queue on schedule.
+            while pending and pending[0].arrival_s <= now:
+                req = pending.popleft()
+                req.t_arrival = t0 + req.arrival_s
+                queue.append(req)
+            # 2. admissions: prefill into free slots.
+            while queue and free:
+                req = queue.popleft()
+                slot = free.popleft()
+                with eng.telemetry.span("prefill", slot=slot,
+                                        tokens=len(req.prompt)):
+                    tok, _ = eng.prefill(req.prompt, slot,
+                                         self.temperature)
+                req.slot = slot
+                req.t_first = req.t_last = time.perf_counter()
+                req.out_tokens = [tok]
+                eng.activate_slot(slot, len(req.prompt), tok)
+                eng.serving.note_prefill(len(req.prompt))
+                if self._finished(req, eng.context_len(slot)):
+                    self._complete(req)
+                    eng.release_slot(slot)
+                    free.append(slot)
+                else:
+                    active[slot] = req
+            # 3. one decode iteration for every live slot.
+            if active:
+                sampled, _ = eng.decode_once(self.temperature)
+                t_now = time.perf_counter()
+                for slot in list(active):
+                    req = active[slot]
+                    req.out_tokens.append(int(sampled[slot]))
+                    req.t_last = t_now
+                    if self._finished(req, eng.context_len(slot)):
+                        self._complete(req)
+                        eng.release_slot(slot)
+                        free.append(slot)
+                        del active[slot]
+            elif pending and not queue:
+                # Idle ahead of the next arrival — open-loop wait.
+                gap = pending[0].arrival_s - (time.perf_counter() - t0)
+                if gap > 0:
+                    time.sleep(min(gap, self.idle_sleep_s))
+            elif queue:
+                # Queued work but no free slot and nothing decoding:
+                # capacity is held outside this serve (caller-activated
+                # slots). Yield instead of busy-spinning.
+                time.sleep(self.idle_sleep_s)
+
+        wall = time.perf_counter() - t0
+        # Final drain with a SERVE-WALL-anchored snapshot: a run shorter
+        # than report_steps iterations would otherwise never put the
+        # aggregator snapshot (tokens/s, decode-step percentiles) into
+        # any report record, and telemetry_report's serving section
+        # would carry nulls; the last report record wins there, so this
+        # also pins the figure benches compare to the same wall
+        # SERVE_BENCH.json uses.
+        if eng.telemetry.enabled:
+            eng.telemetry.drain({"serving": eng.serving.snapshot(
+                wall_s=wall)})
+        report = dict(eng.serving.snapshot(wall_s=wall))
+        report["recompiles"] = eng.telemetry.recompile_count
+        report["unfinished"] = len(pending) + len(queue) + len(active)
+        report["requests"] = [
+            {"rid": r.rid, "prompt_tokens": len(r.prompt),
+             "new_tokens": len(r.out_tokens),
+             "ttft_ms": round(r.ttft_s * 1e3, 3)
+             if r.ttft_s is not None else None,
+             "tpot_ms": round(r.tpot_s * 1e3, 3)
+             if r.tpot_s is not None else None,
+             "tokens": list(map(int, r.out_tokens))}
+            for r in sorted(requests, key=lambda r: r.rid)]
+        return report
+
+
+__all__ = ["Request", "synthetic_requests", "ContinuousBatchingScheduler"]

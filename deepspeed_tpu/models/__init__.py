@@ -7,15 +7,15 @@ mesh-axis sharding specs (Megatron-style TP), scan-over-layers compilation,
 and remat policies standing in for the reference's memory knobs.
 """
 from .transformer import TransformerConfig, layer_norm, dense
-from .gpt2 import (GPT2Config, gpt2_init, gpt2_apply, gpt2_loss_fn,
-                   gpt2_param_shardings, GPT2_CONFIGS)
+from .gpt2 import (GPT2Config, gpt2_init, gpt2_apply, gpt2_logits_at,
+                   gpt2_loss_fn, gpt2_param_shardings, GPT2_CONFIGS)
 from .bert import (BertConfig, bert_init, bert_apply, bert_mlm_loss_fn,
                    bert_param_shardings, BERT_CONFIGS)
 
 __all__ = [
     "TransformerConfig", "layer_norm", "dense",
-    "GPT2Config", "gpt2_init", "gpt2_apply", "gpt2_loss_fn",
-    "gpt2_param_shardings", "GPT2_CONFIGS",
+    "GPT2Config", "gpt2_init", "gpt2_apply", "gpt2_logits_at",
+    "gpt2_loss_fn", "gpt2_param_shardings", "GPT2_CONFIGS",
     "BertConfig", "bert_init", "bert_apply", "bert_mlm_loss_fn",
     "bert_param_shardings", "BERT_CONFIGS",
 ]

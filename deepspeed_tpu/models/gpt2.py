@@ -15,10 +15,11 @@ from __future__ import annotations
 
 import dataclasses
 import math
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .transformer import (TransformerConfig, apply_blocks, block_param_shardings,
@@ -98,6 +99,34 @@ def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
     # models; here it is structural).
     logits = x @ params["wte"].astype(cfg.dtype).T
     return logits
+
+
+def gpt2_logits_at(params: Dict[str, Any], tokens: jnp.ndarray,
+                   cfg: GPT2Config, index: Union[int, jnp.ndarray] = -1,
+                   rng: Optional[jax.Array] = None,
+                   deterministic: bool = True,
+                   attention_fn=None) -> jnp.ndarray:
+    """Logits at ONE sequence position: tokens [B, S] → [B, V].
+
+    Runs the full hidden stack but projects only position ``index``
+    through the tied unembedding, so the [B, S, vocab] logits tensor never
+    materializes — the serving-side memory contract (the training-side
+    equivalent is ops/cross_entropy's chunked projection). ``index`` may
+    be a Python int (negative = from the end) or a traced scalar (the
+    inference prefill path indexes the prompt's final token inside a
+    jitted program).
+    """
+    x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=deterministic,
+                    attention_fn=attention_fn)
+    if isinstance(index, int):
+        if index < 0:
+            index += tokens.shape[1]
+    else:
+        # Traced scalar: dynamic_index_in_dim would CLAMP a negative
+        # index to 0 (silent wrong position) — normalize in-graph.
+        index = jnp.where(index < 0, index + tokens.shape[1], index)
+    h = lax.dynamic_index_in_dim(x, index, axis=1, keepdims=False)  # [B, H]
+    return h @ params["wte"].astype(h.dtype).T
 
 
 def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):

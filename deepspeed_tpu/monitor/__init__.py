@@ -20,6 +20,7 @@ from .memory import (MemoryWatermark, analytic_state_bytes,
 from .peaks import (TPU_PEAK_TFLOPS, ChipPeaks, chip_peak_tflops,
                     chip_peaks)
 from .recompile import RecompileError, RecompileSentinel
+from .serving import ServingAggregator
 from .telemetry import JsonlSink, Telemetry
 from .trace import ProfilerWindow, TraceWriter
 
@@ -27,7 +28,7 @@ __all__ = [
     "Telemetry", "JsonlSink", "TraceWriter", "ProfilerWindow",
     "RecompileSentinel", "RecompileError", "MemoryWatermark",
     "analytic_state_bytes", "device_memory_stats",
-    "GoodputLedger", "GOODPUT_BUCKETS",
+    "GoodputLedger", "GOODPUT_BUCKETS", "ServingAggregator",
     "build_cost_model", "roofline", "mfu",
     "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT",
     "ChipPeaks", "chip_peaks", "chip_peak_tflops", "TPU_PEAK_TFLOPS",

@@ -1,0 +1,101 @@
+"""Per-request goodput metrics for the serving tier.
+
+The serving analogues of the training spine's step records: while the
+trainer's unit of accounting is the optimizer step, serving accounts per
+REQUEST (TTFT — time to first token, queue wait included; TPOT — mean
+time per output token after the first) and per decode ITERATION (batch
+occupancy = active slots / total slots; the number that says whether
+continuous batching is actually keeping the chip busy).
+
+All inputs are host wall-clock and host counters — aggregation adds
+zero device syncs. ``ServingAggregator.snapshot()`` is the one shape
+every consumer speaks: the engine's drain extra, SERVE_BENCH.json, and
+``tools/telemetry_report.py``'s ``serving`` section.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+
+def percentile(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile on an already-sorted list (the same rule
+    tools/telemetry_report.py uses — keep the figures comparable)."""
+    if not sorted_vals:
+        return 0.0
+    k = max(0, min(len(sorted_vals) - 1,
+                   int(round(q / 100.0 * (len(sorted_vals) - 1)))))
+    return float(sorted_vals[k])
+
+
+def _pcts(vals: List[float]) -> Dict[str, float]:
+    s = sorted(vals)
+    return {"p50": round(percentile(s, 50), 3),
+            "p95": round(percentile(s, 95), 3),
+            "mean": round(sum(s) / len(s), 3) if s else 0.0,
+            "n": len(s)}
+
+
+class ServingAggregator:
+    """Accumulates per-iteration and per-request serving metrics."""
+
+    def __init__(self, max_slots: int):
+        self.max_slots = max(1, int(max_slots))
+        self.t0 = time.perf_counter()
+        self.iterations = 0
+        self.decode_tokens = 0
+        self.prefill_tokens = 0
+        self.completed = 0
+        self._occupancy: List[float] = []
+        self._decode_ms: List[float] = []
+        self._ttft_ms: List[float] = []
+        self._tpot_ms: List[float] = []
+
+    # ---- per decode iteration ---- #
+    def note_iteration(self, active_slots: int, decode_s: float) -> None:
+        self.iterations += 1
+        self.decode_tokens += int(active_slots)
+        self._occupancy.append(active_slots / self.max_slots)
+        self._decode_ms.append(decode_s * 1e3)
+
+    def note_prefill(self, prompt_tokens: int) -> None:
+        self.prefill_tokens += int(prompt_tokens)
+
+    # ---- per completed request ---- #
+    def note_request(self, ttft_s: float, tpot_s: Optional[float],
+                     new_tokens: int) -> None:
+        self.completed += 1
+        self._ttft_ms.append(ttft_s * 1e3)
+        if tpot_s is not None:
+            self._tpot_ms.append(tpot_s * 1e3)
+
+    @property
+    def occupancy_mean(self) -> float:
+        if not self._occupancy:
+            return 0.0
+        return sum(self._occupancy) / len(self._occupancy)
+
+    def snapshot(self, wall_s: Optional[float] = None) -> Dict[str, Any]:
+        """The canonical serving summary. ``tokens_per_s`` counts
+        GENERATED (decode) tokens over the serve wall — prefill tokens
+        are reported separately, not inflated into throughput."""
+        wall = wall_s if wall_s is not None \
+            else time.perf_counter() - self.t0
+        return {
+            "iterations": self.iterations,
+            "completed": self.completed,
+            "occupancy_mean": round(self.occupancy_mean, 4),
+            "occupancy_p50": round(
+                percentile(sorted(self._occupancy), 50), 4),
+            "decode_tokens": self.decode_tokens,
+            "prefill_tokens": self.prefill_tokens,
+            "tokens_per_s": round(self.decode_tokens / wall, 3)
+            if wall > 0 else 0.0,
+            "wall_s": round(wall, 6),
+            "ttft_ms": _pcts(self._ttft_ms),
+            "tpot_ms": _pcts(self._tpot_ms),
+            "decode_step_ms": _pcts(self._decode_ms),
+        }
+
+
+__all__ = ["ServingAggregator", "percentile"]

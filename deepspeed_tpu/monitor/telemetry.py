@@ -284,6 +284,12 @@ class Telemetry:
     def event(self, kind: str, payload: Dict[str, Any]) -> None:
         if not self.enabled:
             return
+        # Meta must LEAD the stream: telemetry_report treats a meta
+        # record as a new-run boundary and resets its accumulators, so
+        # an event written before the first drain (an early recompile, a
+        # serving request completing inside the first report window)
+        # would otherwise be dropped from the summary.
+        self._ensure_meta()
         rec = {"kind": "event", "event": kind,
                "step": int(self.step_provider()), "ts": time.time(),
                **payload}

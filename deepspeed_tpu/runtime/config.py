@@ -152,6 +152,50 @@ class TelemetryConfig:
                 f"got {self.cost_model!r}")
 
 
+class InferenceConfig:
+    """The ``inference`` block (inference/ serving subsystem).
+
+    Every knob here is STATIC compiled-program shape: slot count, cache
+    sequence capacity, weight quantization mode, prefill chunk length.
+    The continuous-batching scheduler varies the ACTIVE request set at
+    run time without touching any of them — that is what keeps the
+    decode step at one compilation for the whole serve.
+    """
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.INFERENCE, {})
+        get = config_utils.get_scalar_param
+        self.max_slots = get(d, C.INFERENCE_MAX_SLOTS,
+                             C.INFERENCE_MAX_SLOTS_DEFAULT)
+        self.max_seq_len = get(d, C.INFERENCE_MAX_SEQ_LEN,
+                               C.INFERENCE_MAX_SEQ_LEN_DEFAULT)
+        self.quantize = get(d, C.INFERENCE_QUANTIZE,
+                            C.INFERENCE_QUANTIZE_DEFAULT)
+        self.prefill_chunk = get(d, C.INFERENCE_PREFILL_CHUNK,
+                                 C.INFERENCE_PREFILL_CHUNK_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        if not isinstance(self.max_slots, int) or self.max_slots <= 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_SLOTS} must be a positive "
+                f"int, got {self.max_slots!r}")
+        if not isinstance(self.max_seq_len, int) or self.max_seq_len < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_MAX_SEQ_LEN} must be a "
+                f"non-negative int (0 = model max), got "
+                f"{self.max_seq_len!r}")
+        if self.quantize not in C.INFERENCE_QUANTIZE_MODES:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_QUANTIZE} must be one of "
+                f"{C.INFERENCE_QUANTIZE_MODES}, got {self.quantize!r}")
+        if not isinstance(self.prefill_chunk, int) or self.prefill_chunk < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PREFILL_CHUNK} must be a "
+                f"non-negative int (0 = whole-prompt prefill), got "
+                f"{self.prefill_chunk!r}")
+
+
 class MeshConfig:
     """TPU-native extension: requested logical mesh axis sizes.
 
@@ -271,6 +315,7 @@ class DeepSpeedConfig:
         self.tensorboard_config = TensorboardConfig(d)
         self.telemetry_config = TelemetryConfig(
             d, tensorboard=self.tensorboard_config)
+        self.inference_config = InferenceConfig(d)
         self.mesh_config = MeshConfig(d)
 
         fp16 = d.get(C.FP16, {})

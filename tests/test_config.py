@@ -219,6 +219,57 @@ class TestZeroConfig:
         assert not cfg.zero_config.reduce_scatter
 
 
+class TestInferenceConfig:
+    """The serving tier's `inference` block: every knob is static
+    compiled-program shape, so bad values must die at config parse, not
+    as a shape error three compiles deep."""
+
+    def test_defaults(self):
+        from deepspeed_tpu import constants as C
+        cfg = make_cfg({"train_batch_size": 8})
+        inf = cfg.inference_config
+        assert inf.max_slots == C.INFERENCE_MAX_SLOTS_DEFAULT == 8
+        assert inf.max_seq_len == 0          # 0 = model max
+        assert inf.quantize == "none"
+        assert inf.prefill_chunk == C.INFERENCE_PREFILL_CHUNK_DEFAULT
+
+    def test_explicit_values(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "inference": {"max_slots": 16, "max_seq_len": 256,
+                                      "quantize": "int8",
+                                      "prefill_chunk": 64}})
+        inf = cfg.inference_config
+        assert inf.max_slots == 16
+        assert inf.max_seq_len == 256
+        assert inf.quantize == "int8"
+        assert inf.prefill_chunk == 64
+
+    def test_standalone_parse(self):
+        """InferenceEngine parses the block from a raw dict without the
+        training batch keys — the serving config needs no batch triple."""
+        from deepspeed_tpu.runtime.config import InferenceConfig
+        inf = InferenceConfig({"inference": {"max_slots": 4,
+                                             "quantize": "bf16"}})
+        assert inf.max_slots == 4 and inf.quantize == "bf16"
+        assert InferenceConfig(None).max_slots == 8
+        assert InferenceConfig({}).prefill_chunk == 32
+
+    @pytest.mark.parametrize("bad", [
+        {"max_slots": 0}, {"max_slots": -2}, {"max_slots": 2.5},
+        {"max_seq_len": -1},
+        {"quantize": "fp4"}, {"quantize": True},
+        {"prefill_chunk": -8}, {"prefill_chunk": "auto"},
+    ])
+    def test_invalid_values_raise(self, bad):
+        with pytest.raises(DeepSpeedConfigError):
+            make_cfg({"train_batch_size": 8, "inference": bad})
+
+    def test_chunk_zero_is_whole_prompt(self):
+        cfg = make_cfg({"train_batch_size": 8,
+                        "inference": {"prefill_chunk": 0}})
+        assert cfg.inference_config.prefill_chunk == 0
+
+
 class TestOptimizerScheduler:
     def test_optimizer_params(self):
         cfg = make_cfg({"train_batch_size": 8,

@@ -392,19 +392,42 @@ class TestBenchGate:
 
     def test_extract_metrics_all_shapes(self):
         bg = load_bench_gate()
+        none_srv = {"serve_tps": None, "ttft_p95": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
-        assert m == {"mfu": 0.55, "goodput": None}
+        assert m == {"mfu": 0.55, "goodput": None, **none_srv}
         # raw bench record
         assert bg.extract_metrics({"mfu": 0.5})["mfu"] == 0.5
         # TELEMETRY.json: fenced window figure wins
         m = bg.extract_metrics({
             "mfu": {"window_mfu": 0.4, "per_step_p50": 0.3},
             "goodput": {"goodput_fraction": 0.9}})
-        assert m == {"mfu": 0.4, "goodput": 0.9}
-        # pre-MFU round: nothing extractable
+        assert m == {"mfu": 0.4, "goodput": 0.9, **none_srv}
+        # SERVE_BENCH.json / serving-mode TELEMETRY.json
+        m = bg.extract_metrics({"serving": {
+            "tokens_per_s": 85.3, "ttft_ms": {"p50": 10.0, "p95": 20.0}}})
+        assert m["serve_tps"] == 85.3 and m["ttft_p95"] == 20.0
+        # pre-MFU / pre-serving round: nothing extractable
         assert bg.extract_metrics({"parsed": {"value": 100.0}}) == \
-            {"mfu": None, "goodput": None}
+            {"mfu": None, "goodput": None, **none_srv}
+
+    def test_gate_serving_rounds(self, tmp_path):
+        """Serving tokens/s drop and TTFT p95 rise gate; pre-serving
+        rounds skip, never fail."""
+        bg = load_bench_gate()
+        old = self._write(tmp_path, "old.json", {"serving": {
+            "tokens_per_s": 100.0, "ttft_ms": {"p95": 100.0}}})
+        ok = self._write(tmp_path, "ok.json", {"serving": {
+            "tokens_per_s": 95.0, "ttft_ms": {"p95": 110.0}}})
+        slow = self._write(tmp_path, "slow.json", {"serving": {
+            "tokens_per_s": 80.0, "ttft_ms": {"p95": 100.0}}})
+        laggy = self._write(tmp_path, "laggy.json", {"serving": {
+            "tokens_per_s": 100.0, "ttft_ms": {"p95": 200.0}}})
+        pre = self._write(tmp_path, "pre.json", {"mfu": 0.5})
+        assert bg.main([old, ok]) == 0
+        assert bg.main([old, slow]) == 1
+        assert bg.main([old, laggy]) == 1
+        assert bg.main([pre, old]) == 0        # pre-serving round skips
 
     def test_gate_passes_within_threshold(self, tmp_path):
         bg = load_bench_gate()

@@ -490,7 +490,7 @@ class TestLintAuditArtifact:
         assert record["all_pass"] is True
         assert record["audit_device_fences"] == 0
         for name in ("zero1", "zero2", "onebit", "offload",
-                     "pipeline_1f1b"):
+                     "pipeline_1f1b", "serving"):
             assert record["configs"][name]["pass"] is True, name
 
     def test_every_finding_priced_or_explicitly_unpriced(self, record):

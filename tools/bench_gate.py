@@ -14,15 +14,22 @@ Accepted file shapes (auto-detected per file):
 - a raw bench record (the JSON line bench.py prints);
 - a ``TELEMETRY.json`` from tools/telemetry_report.py — MFU is the
   fenced ``window_mfu`` (per-step p50 as fallback), goodput is the
-  ledger's ``goodput_fraction``.
+  ledger's ``goodput_fraction``;
+- a ``SERVE_BENCH.json`` from tools/serve_bench.py (or a serving-mode
+  TELEMETRY.json) — serving throughput is generated ``tokens_per_s``,
+  serving latency is ``ttft_ms.p95``.
 
 Gate semantics: MFU regresses when it drops by more than ``--mfu-drop``
 RELATIVE (default 10%); goodput regresses when the fraction drops by
-more than ``--goodput-drop`` ABSOLUTE (default 5 points). A metric
-missing on either side is skipped with a notice, never a failure —
-rounds recorded before this tool existed have no ``mfu`` field, and the
-gate must not retroactively break them. Exit 0 = pass/skip, 1 =
-regression, 2 = usage error.
+more than ``--goodput-drop`` ABSOLUTE (default 5 points); serving
+tokens/s regresses on a relative drop beyond ``--serve-drop`` (default
+10%) and TTFT p95 on a relative RISE beyond ``--ttft-rise`` (default
+25% — latency percentiles on a CPU mesh are noisy; the gate catches
+step changes, not jitter). A metric missing on either side is skipped
+with a notice, never a failure — rounds recorded before this tool (or
+before the serving tier) existed have no such field, and the gate must
+not retroactively break them. Exit 0 = pass/skip, 1 = regression, 2 =
+usage error.
 
 Opt-in from CI: ``tools/run_tier1.sh --bench-gate`` (or BENCH_GATE=1).
 """
@@ -43,12 +50,15 @@ def _load(path: str) -> Dict[str, Any]:
 
 
 def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
-    """{"mfu", "goodput"} (None when the file doesn't carry one)."""
+    """{"mfu", "goodput", "serve_tps", "ttft_p95"} (None when the file
+    doesn't carry one)."""
     # Driver round file: the bench record rides in "parsed".
     if isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
     mfu: Optional[float] = None
     goodput: Optional[float] = None
+    serve_tps: Optional[float] = None
+    ttft_p95: Optional[float] = None
     # TELEMETRY.json shape: structured mfu/goodput sections.
     if isinstance(doc.get("mfu"), dict):
         sec = doc["mfu"]
@@ -60,7 +70,17 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     if isinstance(doc.get("goodput"), dict):
         v = doc["goodput"].get("goodput_fraction")
         goodput = float(v) if v is not None else None
-    return {"mfu": mfu, "goodput": goodput}
+    # Serving shape: SERVE_BENCH.json's "serving" record, or a
+    # serving-mode TELEMETRY.json's "serving" section (same keys).
+    srv = doc.get("serving")
+    if isinstance(srv, dict) and (srv.get("available", True)):
+        v = srv.get("tokens_per_s")
+        serve_tps = float(v) if v is not None else None
+        ttft = srv.get("ttft_ms")
+        if isinstance(ttft, dict) and ttft.get("p95") is not None:
+            ttft_p95 = float(ttft["p95"])
+    return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
+            "ttft_p95": ttft_p95}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -82,7 +102,8 @@ def latest_rounds(directory: str) -> Optional[Tuple[str, str]]:
 
 
 def gate(old_path: str, new_path: str, mfu_drop: float,
-         goodput_drop: float) -> int:
+         goodput_drop: float, serve_drop: float = 0.10,
+         ttft_rise: float = 0.25) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -119,9 +140,40 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"goodput: skipped (no goodput section in "
               f"{', '.join(missing)})")
 
+    if old["serve_tps"] is not None and new["serve_tps"] is not None:
+        compared += 1
+        floor = old["serve_tps"] * (1.0 - serve_drop)
+        verdict = "OK" if new["serve_tps"] >= floor else "REGRESSION"
+        print(f"serving tokens/s: {name_old}={old['serve_tps']:.4g} -> "
+              f"{name_new}={new['serve_tps']:.4g} "
+              f"(floor {floor:.4g}, -{serve_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-serving rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["serve_tps"] is None]
+        print(f"serving tokens/s: skipped (no serving section in "
+              f"{', '.join(missing)})")
+
+    if old["ttft_p95"] is not None and new["ttft_p95"] is not None:
+        compared += 1
+        ceil = old["ttft_p95"] * (1.0 + ttft_rise)
+        verdict = "OK" if new["ttft_p95"] <= ceil else "REGRESSION"
+        print(f"serving ttft p95: {name_old}={old['ttft_p95']:.4g}ms -> "
+              f"{name_new}={new['ttft_p95']:.4g}ms "
+              f"(ceiling {ceil:.4g}ms, +{ttft_rise:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["ttft_p95"] is None]
+        print(f"serving ttft p95: skipped (no serving section in "
+              f"{', '.join(missing)})")
+
     if compared == 0:
         print("bench_gate: nothing comparable between the two files "
-              "(pre-MFU rounds?) — passing")
+              "(pre-MFU / pre-serving rounds?) — passing")
     return rc
 
 
@@ -136,6 +188,12 @@ def main(argv=None) -> int:
     ap.add_argument("--goodput-drop", type=float, default=0.05,
                     help="max tolerated ABSOLUTE goodput-fraction drop "
                          "(default 0.05)")
+    ap.add_argument("--serve-drop", type=float, default=0.10,
+                    help="max tolerated RELATIVE serving tokens/s drop "
+                         "(default 0.10)")
+    ap.add_argument("--ttft-rise", type=float, default=0.25,
+                    help="max tolerated RELATIVE TTFT p95 rise "
+                         "(default 0.25)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -150,7 +208,8 @@ def main(argv=None) -> int:
         ap.error("pass exactly two files, or none for auto-discovery")
         return 2
     try:
-        return gate(old_path, new_path, args.mfu_drop, args.goodput_drop)
+        return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
+                    args.serve_drop, args.ttft_rise)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -17,6 +17,10 @@ Flagships (the engine modes whose compiled programs differ):
 - **onebit**  — 1-bit Adam compression step (stage 0 shard_map psums)
 - **offload** — ZeRO-Offload bucketed grad pass (host Adam)
 - **pipeline_1f1b** — compiled pp=2 interleaved pipeline ticks
+- **serving** — the inference tier's decode + chunked-prefill paths
+  (gpt2-tiny, continuous batching); the serving contract is host_sync
+  and materialization CLEAN: no full-cache gather under the slot-over-dp
+  sharding, no in-step host transfer
 
 Known-and-roadmapped findings live in ``tools/lint_waivers.json`` —
 every waiver must match a live finding (stale waivers fail ``--check``),
@@ -166,12 +170,31 @@ def build_pipeline_1f1b():
     return engine
 
 
+def build_serving():
+    from deepspeed_tpu.inference import InferenceEngine, synthetic_requests
+    from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init
+
+    cfg = GPT2_CONFIGS["gpt2-tiny"]
+    engine = InferenceEngine(
+        cfg, gpt2_init(jax.random.PRNGKey(0), cfg),
+        config={"inference": {"max_slots": 8, "max_seq_len": 64,
+                              "prefill_chunk": 8},
+                "telemetry": _tel("serving")})
+    # A short continuous-batching serve registers both compiled paths
+    # (decode_step + prefill_step) with the sentinel.
+    engine.serve(synthetic_requests(4, prompt_len=(6, 12),
+                                    max_new_tokens=4,
+                                    vocab_size=cfg.vocab_size))
+    return engine
+
+
 FLAGSHIPS = {
     "zero1": build_zero1,
     "zero2": build_zero2,
     "onebit": build_onebit,
     "offload": build_offload,
     "pipeline_1f1b": build_pipeline_1f1b,
+    "serving": build_serving,
 }
 
 

@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""Serving bench: drive a synthetic open-loop arrival stream through the
+InferenceEngine and record SERVE_BENCH.json.
+
+The serving acceptance artifact: batch occupancy, TTFT/TPOT p50/p95,
+generated tokens/s, decode-step wall percentiles, and the recompile
+count (which must be ZERO post-warmup — the bench runs with
+``fail_on_recompile`` armed, so a retrace kills the run rather than
+silently polluting the numbers). The engine's telemetry JSONL is
+summarized through ``tools/telemetry_report.py`` and its ``serving``
+section is embedded verbatim, proving the report pipeline and the bench
+agree on the same stream.
+
+Honest methodology note (recorded in the artifact): on the virtual
+8-device CPU mesh the ABSOLUTE numbers (tokens/s, TTFT) measure XLA's
+CPU backend, not a TPU; what transfers is the structure — occupancy
+under continuous batching, the zero-recompile property, and the
+relative cost split between prefill and decode. ``tools/bench_gate.py``
+diffs serving rounds on these figures.
+
+Usage:
+    python tools/serve_bench.py [--model gpt2-tiny] [--slots 8]
+        [--requests 24] [--max-new 16] [--chunk 8] [--max-len 128]
+        [--rate 0.0] [--quantize none] [--temperature 0.0]
+        [--out SERVE_BENCH.json]
+"""
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax                     # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np             # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--model", default="gpt2-tiny")
+    ap.add_argument("--slots", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=24)
+    ap.add_argument("--max-new", type=int, default=16)
+    ap.add_argument("--chunk", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--prompt-len", type=int, nargs=2, default=(8, 24))
+    ap.add_argument("--rate", type=float, default=0.0,
+                    help="open-loop arrival rate (req/s); 0 = saturation "
+                         "(all arrive at t=0)")
+    ap.add_argument("--quantize", default="none",
+                    choices=("none", "bf16", "int8"))
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
+    args = ap.parse_args()
+
+    from deepspeed_tpu.inference import InferenceEngine, synthetic_requests
+    from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init
+
+    cfg = GPT2_CONFIGS[args.model]
+    params = gpt2_init(jax.random.PRNGKey(args.seed), cfg)
+    tel_dir = tempfile.mkdtemp(prefix="serve_bench_")
+    engine = InferenceEngine(cfg, params, config={
+        "inference": {"max_slots": args.slots, "max_seq_len": args.max_len,
+                      "prefill_chunk": args.chunk,
+                      "quantize": args.quantize},
+        "telemetry": {"enabled": True, "output_path": tel_dir,
+                      "job_name": "serve_bench", "report_steps": 16,
+                      "fail_on_recompile": True}})
+    requests = synthetic_requests(
+        args.requests, prompt_len=tuple(args.prompt_len),
+        max_new_tokens=args.max_new, rate_rps=args.rate,
+        vocab_size=cfg.vocab_size, seed=args.seed)
+    print(f"[serve_bench] {args.model}: {args.requests} requests, "
+          f"{args.slots} slots, max_new={args.max_new}, "
+          f"chunk={args.chunk}, quantize={args.quantize} ...", flush=True)
+    report = engine.serve(requests, temperature=args.temperature)
+    engine.close()
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from telemetry_report import summarize
+    telemetry = summarize(os.path.join(tel_dir, "serve_bench.jsonl"))
+
+    record = {
+        "generated_by": "tools/serve_bench.py",
+        "mesh": {"devices": jax.device_count(),
+                 "backend": jax.devices()[0].platform,
+                 "jax": jax.__version__,
+                 "dp": engine.dp, "mp": engine.mp},
+        "model": args.model,
+        "config": {"max_slots": args.slots, "max_seq_len": args.max_len,
+                   "prefill_chunk": args.chunk,
+                   "quantize": args.quantize, "requests": args.requests,
+                   "max_new_tokens": args.max_new,
+                   "prompt_len": list(args.prompt_len),
+                   "arrival_rate_rps": args.rate,
+                   "temperature": args.temperature},
+        "serving": {k: v for k, v in report.items() if k != "requests"},
+        "telemetry_report_serving": telemetry.get("serving"),
+        "honest_note": (
+            "virtual 8-device CPU mesh: absolute tokens/s and latency "
+            "measure XLA's CPU backend, not a TPU. The transferable "
+            "claims are structural — batch occupancy under continuous "
+            "batching, zero post-warmup recompiles (fail_on_recompile "
+            "was armed for this run), and the prefill/decode cost "
+            "split."),
+    }
+    with open(args.out, "w") as f:
+        json.dump(record, f, indent=1)
+    s = record["serving"]
+    print(f"[serve_bench] wrote {args.out}: occupancy="
+          f"{s['occupancy_mean']}, tokens/s={s['tokens_per_s']}, "
+          f"ttft p50/p95={s['ttft_ms']['p50']}/{s['ttft_ms']['p95']} ms, "
+          f"tpot p50/p95={s['tpot_ms']['p50']}/{s['tpot_ms']['p95']} ms, "
+          f"recompiles={s['recompiles']}, completed={s['completed']}")
+    if s["recompiles"] or s["unfinished"]:
+        print("[serve_bench] FAILED acceptance (recompiles or unfinished "
+              "requests)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,9 +28,15 @@ so benches and CI can compare runs:
   and measured-p50 vs floor (how far the run sits from the ceiling).
 - ``goodput``: bucket totals aggregated across every settled window,
   the goodput fraction, and the sum-to-wall consistency verdict.
+- ``serving``: present when the stream came from the inference tier
+  (meta ``mode: "serving"`` or serving-shaped records): batch occupancy
+  over decode iterations, TTFT/TPOT p50/p95 from ``request_complete``
+  events, tokens/s and decode-step percentiles from the last report's
+  aggregator snapshot.
 
 ``tools/bench_gate.py`` diffs the mfu/goodput sections across bench
-rounds and fails CI on regression.
+rounds — and the serving section across serving rounds — and fails CI
+on regression.
 """
 from __future__ import annotations
 
@@ -203,6 +209,45 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
                               for w in gp_windows),
         })
 
+    # Serving: occupancy from the decode-step records, per-request
+    # latency percentiles recomputed from the request_complete events
+    # (ground truth, not a snapshot), throughput from the last report's
+    # aggregator snapshot.
+    completions = [e for e in events
+                   if e.get("event") == "request_complete"]
+    occ = sorted(float(r["occupancy"]) for r in steps
+                 if "occupancy" in r)
+    serve_snap: Dict[str, Any] = {}
+    for rep in reversed(reports):
+        if isinstance(rep.get("serving"), dict):
+            serve_snap = rep["serving"]
+            break
+    is_serving = meta.get("mode") == "serving" or bool(occ) or \
+        bool(completions)
+    serving: Dict[str, Any] = {"available": is_serving}
+    if is_serving:
+        ttfts = sorted(float(e["ttft_ms"]) for e in completions
+                       if "ttft_ms" in e)
+        tpots = sorted(float(e["tpot_ms"]) for e in completions
+                       if "tpot_ms" in e)
+        serving.update({
+            "decode_iterations": len(occ),
+            "occupancy_mean": round(sum(occ) / len(occ), 4)
+            if occ else 0.0,
+            "occupancy_p50": round(_percentile(occ, 50), 4),
+            "completed": len(completions),
+            "ttft_ms": {"p50": round(_percentile(ttfts, 50), 3),
+                        "p95": round(_percentile(ttfts, 95), 3),
+                        "n": len(ttfts)},
+            "tpot_ms": {"p50": round(_percentile(tpots, 50), 3),
+                        "p95": round(_percentile(tpots, 95), 3),
+                        "n": len(tpots)},
+            "tokens_per_s": serve_snap.get("tokens_per_s"),
+            "decode_step_ms": serve_snap.get("decode_step_ms"),
+            "prefill_tokens": serve_snap.get("prefill_tokens"),
+            "decode_tokens": serve_snap.get("decode_tokens"),
+        })
+
     offload_steps = [r["offload"] for r in steps
                      if isinstance(r.get("offload"), dict)]
     offload: Optional[Dict[str, Any]] = None
@@ -250,6 +295,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "mfu": mfu,
         "roofline": roofline,
         "goodput": goodput,
+        "serving": serving,
     }
 
 
@@ -267,13 +313,17 @@ def main(argv=None) -> int:
         summary["mfu"].get("per_step_p50")
     gp = summary["goodput"].get("goodput_fraction")
     bound = summary["roofline"].get("step_bound")
+    srv = summary["serving"]
     print(f"{args.output}: {summary['steps_recorded']} steps, "
           f"p50={st['p50']}ms p95={st['p95']}ms, "
           f"recompiles={summary['recompiles']['count']}, "
           f"watermarks={summary['memory']['watermark_events']}"
           + (f", mfu={mfu}" if mfu is not None else "")
           + (f", {bound}-bound" if bound else "")
-          + (f", goodput={gp:.1%}" if gp is not None else ""))
+          + (f", goodput={gp:.1%}" if gp is not None else "")
+          + (f", serving: occ={srv['occupancy_mean']}, "
+             f"ttft p50={srv['ttft_ms']['p50']}ms"
+             if srv.get("available") else ""))
     return 0
 
 
