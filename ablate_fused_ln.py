@@ -1,0 +1,216 @@
+"""Perf ablation for the fused elementwise kernels + one-pass optimizer
+(ISSUE 8, dev tool).
+
+Two modes, auto-selected by backend:
+
+- **TPU**: measure.  Runs the engine-step ablation grid
+  (``bench.bench_kernels_ablation``: fused/unfused elementwise x
+  one-pass/two-pass optimizer) on the bench model and records the
+  measured step times — the ladder evidence.
+- **CPU dev box**: project.  Interpret-mode Pallas timings measure the
+  interpreter, not the kernels, so the tool computes the ANALYTIC
+  saving instead and prices it against the last measured TPU round
+  (BENCH_r05): the one-pass optimizer removes the separate full-tree
+  norm read (a structural f32 pass over every gradient element), and
+  the fused elementwise kernels remove a conservative count of
+  residual-stream round-trips the unfused chain makes (assumptions
+  recorded in the artifact).  The resulting record is labeled
+  ``"projected": true`` everywhere — it is a model, not a measurement.
+
+``--record`` writes BENCH_r06.json in the driver-round shape
+(``{"n": 6, "parsed": {bench record}}``) so ``tools/bench_gate.py``
+diffs it against BENCH_r05 like any other round.
+
+Usage: python ablate_fused_ln.py [model] [--record]
+"""
+import dataclasses
+import json
+import os
+import re
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deepspeed_tpu.models import GPT2_CONFIGS
+from deepspeed_tpu.models.gpt2 import gpt2_flops_per_token, gpt2_num_params
+from deepspeed_tpu.monitor.peaks import chip_peaks, chip_peak_tflops
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+RECORD = "--record" in sys.argv
+ARGS = [a for a in sys.argv[1:] if not a.startswith("--")]
+MODEL = ARGS[0] if ARGS else "gpt2-large"
+R05 = os.path.join(REPO, "BENCH_r05.json")
+OUT = os.path.join(REPO, "BENCH_r06.json")
+
+# BENCH_r05's measured bench point (the projection baseline); re-read
+# from the artifact when present so the numbers cannot drift apart.
+# profile_matmul_bound.py imports BOTH of these — one definition of the
+# fallback and one parser, so the two tools can never disagree on the
+# baseline.
+R05_DEFAULTS = {"tflops": 108.36, "tok_s": 20826.0, "mbs": 4}
+_TOK_S_RE = re.compile(r"([\d,.]+)\s*tok/s")
+
+
+def parse_tok_s(unit: str):
+    """tok/s out of a bench record's unit string, thousands-separator
+    safe ("... 20,826 tok/s, 55.0% of peak ..."); None when absent."""
+    m = _TOK_S_RE.search(unit or "")
+    return float(m.group(1).replace(",", "")) if m else None
+
+
+def _r05_point():
+    out = dict(R05_DEFAULTS)
+    try:
+        with open(R05) as f:
+            parsed = json.load(f).get("parsed", {})
+        out["tflops"] = float(parsed.get("value", out["tflops"]))
+        tok_s = parse_tok_s(parsed.get("unit", ""))
+        if tok_s:
+            out["tok_s"] = tok_s
+    except Exception:
+        pass
+    return out
+
+
+def projected_record(model_name: str):
+    """The CPU-dev-box analytic projection (see module docstring)."""
+    cfg = dataclasses.replace(GPT2_CONFIGS[model_name],
+                              max_seq_length=1024)
+    base = _r05_point()
+    mbs = base["mbs"]
+    S, H, L = cfg.max_seq_length, cfg.hidden_size, cfg.num_layers
+    F = cfg.ffn_size
+    T = mbs * S                                # tokens per step
+    n_params = gpt2_num_params(cfg)
+    peaks = chip_peaks()                       # assumed v5e on CPU
+    hbm = peaks.hbm_bytes_per_sec
+
+    step_ms = mbs * S / base["tok_s"] * 1e3
+
+    # (1) One-pass optimizer: priced by the HONEST model
+    # (ops/fused_update.apply_hbm_bytes) at the r05 bench flags —
+    # master-free bf16, no fp16, no gradient clipping, no cast cache.
+    # That delta is ZERO bytes: the bench config never computed a norm
+    # and its overflow select was already a folded compile-time
+    # constant.  The one-pass machinery's byte wins live in fp16
+    # (~2.5x: unscale + vote + real select) and cast-cache (~1.1x)
+    # configs; its bench-config win is kernel-launch count, which this
+    # byte model deliberately does not price.
+    from deepspeed_tpu.ops.fused_update import apply_hbm_bytes
+    fake = {"p": jax.ShapeDtypeStruct((n_params,), jnp.bfloat16)}
+    pricing = apply_hbm_bytes(fake, one_pass=True, clip=False, fp16=False)
+    opt_saved_bytes = pricing["two_pass"] - pricing["one_pass"]
+    opt_saved_ms = opt_saved_bytes / hbm * 1e3
+
+    # (2) Fused elementwise: CONSERVATIVE per-layer pass model — only
+    # round-trips that are structural in the unfused chain and
+    # provably absent in the fused kernels are claimed:
+    #   fwd: the residual sum is re-READ by the next LN (fused: LN
+    #        consumes it in-register)                      -> 1x T*H
+    #   bwd: the LN backward re-reads the saved input for its second
+    #        reduction (fused: one read, stats recomputed) -> 1x T*H
+    #        the GELU backward re-reads dz for the dbias
+    #        reduction (fused: partial in the same pass)   -> 1x T*F
+    # XLA-fusable adjacencies (bias+gelu fwd, scale+shift) are NOT
+    # claimed — XLA already fuses those.
+    bpe = 2                                    # bf16 activations
+    elem_saved_bytes = L * bpe * (T * H + T * H + T * F)
+    elem_saved_ms = elem_saved_bytes / hbm * 1e3
+
+    new_step_ms = step_ms - opt_saved_ms - elem_saved_ms
+    tok_s = mbs * S / (new_step_ms / 1e3)
+    flops_per_tok = gpt2_flops_per_token(cfg, S)
+    tflops = tok_s * flops_per_tok / 1e12
+    frac = tflops / chip_peak_tflops()
+    ref_frac = 64.0 / 125.0
+
+    return {
+        "metric": f"GPT2({H}x{L}) train TFLOPs/chip",
+        "value": round(tflops, 2),
+        "unit": f"TFLOPs/chip (bf16, 1 chip(s), {tok_s:,.0f} tok/s, "
+                f"{frac:.1%} of peak, PROJECTED)",
+        "vs_baseline": round(frac / ref_frac, 3),
+        "mfu": round(frac, 4),
+        "fused_optimizer_apply": True,
+        "projected": True,
+        "kernels": {
+            "model": f"{H}x{L}",
+            "fused_speedup": round(step_ms / new_step_ms, 4),
+            "projected": True,
+            "baseline_round": "BENCH_r05",
+            "baseline_step_ms": round(step_ms, 2),
+            "projected_step_ms": round(new_step_ms, 2),
+            "one_pass_optimizer_saved_ms": round(opt_saved_ms, 3),
+            "fused_elementwise_saved_ms": round(elem_saved_ms, 3),
+            "assumptions": {
+                "hbm_gb_s": round(hbm / 1e9, 1),
+                "optimizer_saved_bytes": int(opt_saved_bytes),
+                "elementwise_saved_bytes": int(elem_saved_bytes),
+                "elementwise_model": "per layer: fwd 1xT*H residual "
+                                     "re-read + bwd 1xT*H LN re-read + "
+                                     "1xT*F GELU dbias re-read, bf16",
+            },
+            "note": "PROJECTED on the CPU dev box from BENCH_r05's "
+                    "measured step + the analytic HBM-byte model above; "
+                    "interpret-mode Pallas cannot time the kernels. The "
+                    "one-pass optimizer term is ZERO for this bench "
+                    "config (master-free bf16, no clip/fp16 — its byte "
+                    "wins live in fp16/cast-cache configs; here it only "
+                    "cuts launches, unpriced). A TPU session re-records "
+                    "this round measured (DS_BENCH_KERNELS=1 python "
+                    "bench.py). The >=70%-of-peak target needs the "
+                    "measured pass; this model claims only the "
+                    "structural byte savings.",
+        },
+    }
+
+
+def measured_record():
+    """TPU: the real ablation grid + headline rerun."""
+    import bench
+    grid = bench.bench_kernels_ablation()
+    cfg, mbs = bench.pick_model()
+    S = cfg.max_seq_length
+    step_ms = grid["step_ms"]["fused_ln+one_pass"]
+    tok_s = mbs * jax.device_count() * S / (step_ms / 1e3)
+    flops_per_tok = gpt2_flops_per_token(cfg, S)
+    tflops = tok_s * flops_per_tok / jax.device_count() / 1e12
+    frac = tflops / chip_peak_tflops()
+    return {
+        "metric": f"GPT2({cfg.hidden_size}x{cfg.num_layers}) train "
+                  "TFLOPs/chip",
+        "value": round(tflops, 2),
+        "unit": f"TFLOPs/chip (bf16, {jax.device_count()} chip(s), "
+                f"{tok_s:,.0f} tok/s, {frac:.1%} of peak)",
+        "vs_baseline": round(frac / (64.0 / 125.0), 3),
+        "mfu": round(frac, 4),
+        "fused_optimizer_apply": True,
+        "kernels": grid,
+    }
+
+
+def main():
+    if jax.devices()[0].platform == "tpu":
+        record = measured_record()
+    else:
+        record = projected_record(MODEL)
+    print(json.dumps(record, indent=1))
+    if RECORD:
+        round_doc = {
+            "n": 6,
+            "cmd": "python ablate_fused_ln.py --record",
+            "rc": 0,
+            "tail": json.dumps(record),
+            "parsed": record,
+        }
+        with open(OUT, "w") as f:
+            json.dump(round_doc, f, indent=1)
+        print(f"[ablate_fused_ln] wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -282,6 +282,96 @@ def bench_telemetry_overhead(n_steps: int = 40):
     }
 
 
+def bench_kernels_ablation(n_steps: int = None):
+    """DS_BENCH_KERNELS=1: the ISSUE-8 ablation grid — fused vs unfused
+    elementwise kernels x one-pass vs two-pass optimizer update — on the
+    bench model (gpt2-large on TPU, gpt2-tiny on the CPU dev box, where
+    interpret-mode Pallas timings measure the interpreter, not the
+    kernels; the CPU record is a wiring check, the TPU record is the
+    ladder evidence; ablate_fused_ln.py carries the analytic projection).
+
+    ``fused_speedup`` (unfused-elementwise two-pass step over fully-fused
+    step) is the figure tools/bench_gate.py gates across rounds.
+    """
+    import dataclasses as _dc
+    from deepspeed_tpu.models import gpt2_init, gpt2_loss_fn
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from deepspeed_tpu.parallel.topology import build_mesh
+
+    cfg0, micro_bs = pick_model()
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if n_steps is None:
+        n_steps = 10 if on_tpu else 2
+    n_chips = jax.device_count()
+    mesh = build_mesh()
+    S = cfg0.max_seq_length
+    batch = jnp.asarray(np.random.randint(
+        0, cfg0.vocab_size, size=(micro_bs * n_chips, S + 1),
+        dtype=np.int32))
+
+    def run(fused_ln: bool, one_pass: bool):
+        cfg = _dc.replace(cfg0, fused_kernels=fused_ln)
+        ds = {
+            "train_batch_size": micro_bs * n_chips,
+            "train_micro_batch_size_per_gpu": micro_bs,
+            "gradient_accumulation_steps": 1,
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True,
+                     "stochastic_rounding":
+                         os.environ.get("DS_BENCH_SR", "1") == "1"},
+            "zero_optimization": {"stage": 2},
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 1e-4, "fused": True}},
+            "steps_per_print": 10 ** 9,
+        }
+        engine = DeepSpeedEngine(model=gpt2_loss_fn(cfg),
+                                 model_params=gpt2_init(
+                                     jax.random.PRNGKey(0), cfg),
+                                 config=ds, mesh=mesh)
+        if not one_pass:
+            # Ablation-only switch: drop back to the historical two-pass
+            # sequencing (separate norm read + post-apply select/cast)
+            # while keeping the same fused apply kernel. The train step
+            # builds lazily, so clearing this BEFORE the first batch is
+            # authoritative — assert that invariant so a future eager
+            # build turns this into a loud failure, not a silent no-op
+            # arm measuring the wrong thing.
+            assert engine._train_step_fn is None, \
+                "train step already built; two-pass ablation arm invalid"
+            engine._fused_step = None
+        for _ in range(3):
+            engine.train_batch(batch)
+        float(jax.device_get(engine.state.loss_scale))
+        t0 = time.perf_counter()
+        for _ in range(n_steps):
+            engine.train_batch(batch)
+        float(jax.device_get(engine.state.loss_scale))
+        return (time.perf_counter() - t0) / n_steps * 1e3
+
+    grid = {
+        "fused_ln+one_pass": run(True, True),
+        "fused_ln+two_pass": run(True, False),
+        "unfused_ln+one_pass": run(False, True),
+        "unfused_ln+two_pass": run(False, False),
+    }
+    base = grid["unfused_ln+two_pass"]
+    best = grid["fused_ln+one_pass"]
+    return {
+        "model": f"{cfg0.hidden_size}x{cfg0.num_layers}",
+        "step_ms": {k: round(v, 2) for k, v in grid.items()},
+        "fused_speedup": round(base / max(best, 1e-9), 4),
+        "one_pass_only_speedup": round(
+            grid["fused_ln+two_pass"] / max(best, 1e-9), 4),
+        "elementwise_only_speedup": round(
+            grid["unfused_ln+one_pass"] / max(best, 1e-9), 4),
+        "measured_on": jax.devices()[0].platform,
+        "note": None if on_tpu else (
+            "CPU dev box: interpret-mode Pallas — timings measure the "
+            "interpreter, not the kernels; see ablate_fused_ln.py for "
+            "the analytic projection"),
+    }
+
+
 def offload_extra():
     """Recorded OFFLOAD_BENCH.json if present, else a live run when
     DS_BENCH_OFFLOAD=1, else a skip marker. Never raises."""
@@ -413,6 +503,15 @@ def main():
     }
     if dp_comm is not None:
         record["dp_comm"] = dp_comm
+    # DS_BENCH_KERNELS=1: the fused-elementwise x one/two-pass-optimizer
+    # ablation grid (ISSUE 8); `kernels.fused_speedup` is gated by
+    # tools/bench_gate.py across rounds. Never fails the bench.
+    if os.environ.get("DS_BENCH_KERNELS") == "1":
+        try:
+            record["kernels"] = bench_kernels_ablation()
+        except Exception as e:  # pragma: no cover - bench resilience
+            record["kernels"] = {
+                "error": f"{type(e).__name__}: {e}"[:200]}
     # DS_BENCH_TELEMETRY=1: enabled-vs-disabled telemetry overhead record
     # (<1% target + zero added device fences). Never fails the bench.
     if os.environ.get("DS_BENCH_TELEMETRY") == "1":

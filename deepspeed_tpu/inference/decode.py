@@ -39,7 +39,8 @@ from jax import lax
 
 from . import kv_cache
 from ..models.gpt2 import GPT2Config
-from ..models.transformer import dense, layer_norm
+from ..models.transformer import (dense, gelu_dense_fn, layer_norm,
+                                  layer_norm_fn)
 
 NEG_INF = jnp.float32(-1e9)    # same masking constant as dense_attention
 
@@ -54,9 +55,13 @@ def _check_cfg(cfg: GPT2Config) -> None:
 
 def _ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: GPT2Config
          ) -> jax.Array:
-    h = layer_norm(x, p["ln2_scale"], p["ln2_bias"], cfg.layer_norm_eps)
-    h = dense(h, p["fc_kernel"], p["fc_bias"])
-    h = jax.nn.gelu(h, approximate=not cfg.gelu_exact)
+    # layer_norm_fn / gelu_dense_fn resolve to the fused Pallas kernels
+    # when cfg enables them — the SAME static dispatch the training
+    # block uses, so flipping the knob never adds a compiled-signature
+    # variant to the serving paths (sentinel-asserted in
+    # tests/test_fused_ln.py).
+    h = layer_norm_fn(cfg)(x, p["ln2_scale"], p["ln2_bias"])
+    h = gelu_dense_fn(cfg)(h, p["fc_kernel"], p["fc_bias"])
     h = dense(h, p["fc_out_kernel"], p["fc_out_bias"])
     return x + h
 
@@ -64,7 +69,7 @@ def _ffn(p: Dict[str, jax.Array], x: jax.Array, cfg: GPT2Config
 def _qkv(p: Dict[str, jax.Array], x: jax.Array, cfg: GPT2Config
          ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """ln1 + QKV projection; x [..., H] → q,k,v [..., nH, dH]."""
-    h = layer_norm(x, p["ln1_scale"], p["ln1_bias"], cfg.layer_norm_eps)
+    h = layer_norm_fn(cfg)(x, p["ln1_scale"], p["ln1_bias"])
     qkv = dense(h, p["qkv_kernel"], p["qkv_bias"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
     split = x.shape[:-1] + (cfg.num_heads, cfg.head_dim)
@@ -112,8 +117,7 @@ def gpt2_decode(params: Dict[str, Any], kc: jax.Array, vc: jax.Array,
         return h, (kcl, vcl)
 
     x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
-    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                   cfg.layer_norm_eps)
+    x = layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
     logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
     return logits, kc, vc
 
@@ -173,8 +177,7 @@ def gpt2_prefill_chunk(params: Dict[str, Any], kc: jax.Array,
         return h, (kcl, vcl)
 
     x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
-    x = layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                   cfg.layer_norm_eps)
+    x = layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
     h_last = lax.dynamic_slice(x, (last_idx.astype(jnp.int32),
                                    jnp.int32(0)), (1, x.shape[1]))[0]
     logits = (h_last @ params["wte"].astype(cfg.dtype).T
@@ -221,8 +224,8 @@ def gpt2_prefill_full(params: Dict[str, Any], kc: jax.Array,
         kc, ks.transpose(0, 2, 1, 3)[:, None].astype(kc.dtype), at)
     vc = lax.dynamic_update_slice(
         vc, vs.transpose(0, 2, 1, 3)[:, None].astype(vc.dtype), at)
-    x = layer_norm(x[0], params["ln_f_scale"], params["ln_f_bias"],
-                   cfg.layer_norm_eps)
+    x = layer_norm_fn(cfg)(x[0], params["ln_f_scale"],
+                           params["ln_f_bias"])
     h_last = lax.dynamic_slice(x, (last_idx.astype(jnp.int32),
                                    jnp.int32(0)), (1, x.shape[1]))[0]
     logits = (h_last @ params["wte"].astype(cfg.dtype).T

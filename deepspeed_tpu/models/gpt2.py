@@ -24,7 +24,7 @@ from jax.sharding import PartitionSpec as P
 
 from .transformer import (TransformerConfig, apply_blocks, block_param_shardings,
                           count_params, dense_attention, init_block_params,
-                          layer_norm)
+                          layer_norm, layer_norm_fn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,8 +85,7 @@ def gpt2_hidden(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
     x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
                      deterministic=deterministic, attention_fn=attention_fn,
                      pld_theta=pld_theta)
-    return layer_norm(x, params["ln_f_scale"], params["ln_f_bias"],
-                      cfg.layer_norm_eps)
+    return layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
 
 
 def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,

@@ -66,6 +66,13 @@ class TransformerConfig:
     # True = erf-form GELU (HF BERT "gelu"); False = tanh approximation
     # (GPT-2 gelu_new, and what the reference's gelu_kernels.cu computes).
     gelu_exact: bool = False
+    # Fused elementwise Pallas kernels (ops/fused_elementwise): residual-
+    # add+LayerNorm and the bias+GELU FFN epilogue. "auto" = on when the
+    # backend is TPU (DS_FUSED_ELEMENTWISE=0/1 overrides); True/False
+    # force — True on CPU runs interpret-mode Pallas (how the dp=8
+    # tier-1 mesh tests them). Static per config: flipping it changes
+    # the program, not the compiled signature.
+    fused_kernels: Any = "auto"
 
     @property
     def ffn_size(self) -> int:
@@ -102,6 +109,54 @@ def dense(x: jnp.ndarray, kernel: jnp.ndarray, bias: Optional[jnp.ndarray]) -> j
 def gelu(x: jnp.ndarray) -> jnp.ndarray:
     # tanh approximation — same curve the reference's gelu_kernels.cu uses.
     return jax.nn.gelu(x, approximate=True)
+
+
+# --------------------------------------------------------------------- #
+# cfg-resolved fused-kernel dispatch (ops/fused_elementwise)
+# --------------------------------------------------------------------- #
+def use_fused_kernels(cfg: "TransformerConfig") -> bool:
+    from ..ops.fused_elementwise import fused_elementwise_enabled
+    return fused_elementwise_enabled(getattr(cfg, "fused_kernels", "auto"))
+
+
+def layer_norm_fn(cfg: "TransformerConfig") -> Callable:
+    """``(x, scale, bias) -> y``: the fused Pallas LayerNorm when the
+    config enables it, the jnp reference otherwise.  The choice is
+    static per config, so every caller (training block, serving
+    decode/prefill) keeps ONE compiled signature either way."""
+    if use_fused_kernels(cfg):
+        from ..ops.fused_elementwise import fused_layer_norm
+        return lambda x, scale, bias: fused_layer_norm(
+            x, scale, bias, cfg.layer_norm_eps)
+    return lambda x, scale, bias: layer_norm(
+        x, scale, bias, cfg.layer_norm_eps)
+
+
+def residual_layer_norm_fn(cfg: "TransformerConfig") -> Callable:
+    """``(x, delta, scale, bias) -> (s, y)`` with ``s = x + delta`` and
+    ``y = LN(s)`` — fused into one pass when enabled."""
+    if use_fused_kernels(cfg):
+        from ..ops.fused_elementwise import fused_residual_layer_norm
+        return lambda x, delta, scale, bias: fused_residual_layer_norm(
+            x, delta, scale, bias, cfg.layer_norm_eps)
+
+    def unfused(x, delta, scale, bias):
+        s = x + delta
+        return s, layer_norm(s, scale, bias, cfg.layer_norm_eps)
+    return unfused
+
+
+def gelu_dense_fn(cfg: "TransformerConfig") -> Callable:
+    """``(h, kernel, bias) -> gelu(h @ kernel + bias)`` — the FFN
+    up-projection with its bias+GELU epilogue fused when enabled (the
+    matmul stays with XLA's MXU GEMM; the kernel fuses everything
+    after it into one elementwise pass)."""
+    if use_fused_kernels(cfg):
+        from ..ops.fused_elementwise import fused_bias_gelu
+        return lambda h, kernel, bias: fused_bias_gelu(
+            h @ kernel.astype(h.dtype), bias, cfg.gelu_exact)
+    return lambda h, kernel, bias: jax.nn.gelu(
+        dense(h, kernel, bias), approximate=not cfg.gelu_exact)
 
 
 def dropout(x: jnp.ndarray, rate: float, rng: Optional[jax.Array],
@@ -211,10 +266,17 @@ def transformer_block(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
     r1 = r2 = r3 = None
     if rng is not None:
         r1, r2, r3 = jax.random.split(rng, 3)
+    # cfg-resolved elementwise ops: the fused Pallas kernels when the
+    # config enables them, the reference jnp chain otherwise (identical
+    # math — the fused residual+LN pass computes s = x + delta then
+    # LN(s) exactly like the two separate ops below would).
+    ln = layer_norm_fn(cfg)
+    res_ln = residual_layer_norm_fn(cfg)
+    gelu_up = gelu_dense_fn(cfg)
 
     # --- attention sublayer ---
-    h = layer_norm(x, params["ln1_scale"], params["ln1_bias"],
-                   cfg.layer_norm_eps) if cfg.pre_layer_norm else x
+    h = ln(x, params["ln1_scale"], params["ln1_bias"]) \
+        if cfg.pre_layer_norm else x
     qkv = dense(h, params["qkv_kernel"], params["qkv_bias"])
     q, k, v = jnp.split(qkv, 3, axis=-1)
     q = q.reshape(B, S, nH, dH)
@@ -226,22 +288,23 @@ def transformer_block(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
     attn = attn.reshape(B, S, H)
     attn = dense(attn, params["proj_kernel"], params["proj_bias"])
     attn = dropout(attn, cfg.hidden_dropout, r2, deterministic)
-    x = x + attn
-    if not cfg.pre_layer_norm:
-        x = layer_norm(x, params["ln1_scale"], params["ln1_bias"],
-                       cfg.layer_norm_eps)
+    if cfg.pre_layer_norm:
+        # Fused residual-add + next sublayer's LN: x continues the
+        # residual stream from s, h feeds the FFN.
+        x, h = res_ln(x, attn, params["ln2_scale"], params["ln2_bias"])
+    else:
+        # Post-LN: the normalized value IS the residual stream.
+        _, x = res_ln(x, attn, params["ln1_scale"], params["ln1_bias"])
+        h = x
 
     # --- FFN sublayer ---
-    h = layer_norm(x, params["ln2_scale"], params["ln2_bias"],
-                   cfg.layer_norm_eps) if cfg.pre_layer_norm else x
-    h = dense(h, params["fc_kernel"], params["fc_bias"])
-    h = jax.nn.gelu(h, approximate=not cfg.gelu_exact)
+    h = gelu_up(h, params["fc_kernel"], params["fc_bias"])
     h = dense(h, params["fc_out_kernel"], params["fc_out_bias"])
     h = dropout(h, cfg.hidden_dropout, r3, deterministic)
-    x = x + h
-    if not cfg.pre_layer_norm:
-        x = layer_norm(x, params["ln2_scale"], params["ln2_bias"],
-                       cfg.layer_norm_eps)
+    if cfg.pre_layer_norm:
+        x = x + h
+    else:
+        _, x = res_ln(x, h, params["ln2_scale"], params["ln2_bias"])
     return x
 
 

@@ -1,0 +1,426 @@
+"""Fused elementwise Pallas kernels: residual-add+LayerNorm and the
+bias+GELU epilogue of the FFN up-projection.
+
+Why these exist (ROADMAP item 2, the non-GEMM third of the step):
+``profile_matmul_bound.py`` puts the pure-GEMM floor of the bench step at
+~2/3 of the achieved time; part of the rest is elementwise passes XLA
+schedules as separate HBM round-trips — LayerNorm reads the residual
+stream, computes mean/var in fp32, and writes it back; the residual add
+that feeds it is another full read+write; GELU and its bias add are two
+more.  The reference attacked the same class of overhead with fused CUDA
+transformer kernels (``csrc/transformer/normalize_kernels.cu``,
+``gelu_kernels.cu``); the TPU-native answer is Pallas row kernels that
+make the one-pass property structural:
+
+- ``fused_layer_norm``: LN over the last axis, fp32 statistics, one read
+  of x and one write of y (fwd) — plus a custom-vjp backward kernel that
+  RECOMPUTES mean/rstd in-block instead of saving them (the
+  ``normalize_invertible`` idea: stats are rank-1 per row, recompute is
+  cheaper than an HBM round-trip).
+- ``fused_residual_layer_norm``: ``s = x + delta; y = LN(s)`` in one
+  pass, returning BOTH (the residual stream continues from ``s``).  The
+  backward fuses the LN input-gradient with the pass-through residual
+  cotangent, so the residual stream's gradient is also one pass.
+- ``fused_bias_gelu``: ``gelu(y + bias)`` (tanh approximation by
+  default, exact-erf behind a flag) with the analytic derivative in the
+  backward kernel — no saved activations beyond the matmul output that
+  already exists.
+
+Numerics contract (tests/test_fused_ln.py): all statistics and
+transcendentals evaluate in fp32 exactly like the jnp reference
+(``models.transformer.layer_norm`` / ``jax.nn.gelu``); fp32 tensors
+agree with the reference to <= a few f32 ulp (cross-program reduction
+association — the PR-1 FMA-contraction tolerance class), bf16 tensors to
+<= 2 bf16 ulp (the fused path rounds ONCE at the output where the
+unfused chain rounds per op — the fused value is the more accurate one).
+
+Sharding caveat (same class as ``ops/flash_attention``): a
+``pallas_call`` is opaque to GSPMD, so under a mesh that shards
+activations *declaratively* XLA gathers the operand around the kernel.
+Every hot path that enables these kernels runs them where tensors are
+already device-local: the ZeRO-2 engines' explicit shard_map gradient
+path, the single-chip bench, and the serving decode/prefill programs
+(slot-sharded caches enter via their own shard_map-free slot math).  The
+``materialization`` lint pass is the watchdog: an activation gather
+around the kernel shows up as a tree-scale buffer and fails CI.
+
+Enable/disable: resolved per model config (``TransformerConfig.
+fused_kernels``): ``"auto"`` = on when the backend is TPU, off on CPU
+(interpret-mode Pallas is a correctness tool, not a fast path);
+``DS_FUSED_ELEMENTWISE=0/1`` overrides "auto" (the bench ablation knob);
+``True``/``False`` force — True on CPU runs the kernels in interpret
+mode, which is how the tier-1 dp=8 mesh tests them.
+"""
+from __future__ import annotations
+
+import functools
+import math
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+try:  # TPU backend bits are importable everywhere; interpret=True on CPU
+    from jax.experimental.pallas import tpu as pltpu
+except Exception:  # pragma: no cover
+    pltpu = None
+
+_LANE = 128
+_SQRT_2_OVER_PI = math.sqrt(2.0 / math.pi)
+_GELU_C = 0.044715
+_ENV_KNOB = "DS_FUSED_ELEMENTWISE"
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def fused_elementwise_enabled(flag="auto") -> bool:
+    """Resolve a config knob value to on/off.
+
+    ``True``/``False`` are forced; ``"auto"`` (the TransformerConfig
+    default) is on exactly when the backend is TPU, overridable with
+    DS_FUSED_ELEMENTWISE=0/1 (the bench/ablation switch).
+    """
+    if flag is True or flag is False:
+        return bool(flag)
+    env = os.environ.get(_ENV_KNOB)
+    if env in ("0", "1"):
+        return env == "1"
+    return jax.default_backend() == "tpu"
+
+
+def _geom(rows: int, H: int, n_bufs: int) -> Tuple[int, int, int]:
+    """(rows_pad, Hpad, rb): lane-pad H to a 128 multiple, pick the
+    largest power-of-two row block whose ``n_bufs`` fp32 copies fit a
+    conservative VMEM budget, pad rows to a block multiple."""
+    Hpad = -(-H // _LANE) * _LANE
+    rb = 128
+    while rb > 16 and rb * Hpad * 4 * n_bufs > 12 * 2 ** 20:
+        rb //= 2
+    rows_pad = -(-rows // rb) * rb
+    return rows_pad, Hpad, rb
+
+
+def _row_spec(rb: int, Hpad: int):
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec((rb, Hpad), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((rb, Hpad), lambda i: (i, 0))
+
+
+def _whole_spec(Hpad: int):
+    """(1, Hpad) broadcast block (scale/bias rows, per-grid partials) —
+    the same sublane-1 block shape the fused-optimizer sqnorm kernel
+    ships on TPU."""
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec((1, Hpad), lambda i: (0, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, Hpad), lambda i: (0, 0))
+
+
+def _part_spec(Hpad: int):
+    if pltpu is not None and jax.default_backend() == "tpu":
+        return pl.BlockSpec((1, Hpad), lambda i: (i, 0),
+                            memory_space=pltpu.VMEM)
+    return pl.BlockSpec((1, Hpad), lambda i: (i, 0))
+
+
+def _pad2(x2: jax.Array, rows_pad: int, Hpad: int) -> jax.Array:
+    r, h = x2.shape
+    if rows_pad > r or Hpad > h:
+        x2 = jnp.pad(x2, ((0, rows_pad - r), (0, Hpad - h)))
+    return x2
+
+
+def _pad_row(v: jax.Array, Hpad: int) -> jax.Array:
+    if Hpad > v.shape[0]:
+        v = jnp.pad(v, (0, Hpad - v.shape[0]))
+    return v.reshape(1, Hpad)
+
+
+def _col_mask(shape, H: int):
+    """True on real columns (H may be lane-padded)."""
+    return lax.broadcasted_iota(jnp.int32, shape, 1) < H
+
+
+# --------------------------------------------------------------------- #
+# LayerNorm kernels
+# --------------------------------------------------------------------- #
+def _ln_stats(xs: jax.Array, H: int, Hpad: int, eps: float):
+    """Row mean / rstd in fp32; pad columns are zero so they drop out of
+    the mean for free, the variance masks them explicitly."""
+    mean = jnp.sum(xs, axis=-1, keepdims=True) / H
+    c = xs - mean
+    if Hpad != H:
+        c = jnp.where(_col_mask(c.shape, H), c, 0.0)
+    var = jnp.sum(c * c, axis=-1, keepdims=True) / H
+    return mean, lax.rsqrt(var + eps)
+
+
+def _ln_fwd_kernel(x_ref, d_ref, scale_ref, bias_ref, *out_refs,
+                   eps: float, H: int, Hpad: int, has_resid: bool,
+                   out_dtype):
+    """One row block: (optional residual add) + LayerNorm.
+
+    The residual sum is rounded to the storage dtype BEFORE the
+    statistics read it — bit-parity with the unfused ``x + attn`` (a
+    bf16 add IS round(f32 sum)); the stats then widen back to fp32
+    exactly like the reference ``layer_norm``.
+    """
+    x = x_ref[...].astype(jnp.float32)
+    if has_resid:
+        s_cast = (x + d_ref[...].astype(jnp.float32)).astype(out_dtype)
+        out_refs[0][...] = s_cast
+        xs = s_cast.astype(jnp.float32)
+        y_out = out_refs[1]
+    else:
+        xs = x
+        y_out = out_refs[0]
+    mean, rstd = _ln_stats(xs, H, Hpad, eps)
+    y = ((xs - mean) * rstd) * scale_ref[...].astype(jnp.float32) + \
+        bias_ref[...].astype(jnp.float32)
+    y_out[...] = y.astype(out_dtype)
+
+
+def _ln_bwd_kernel(s_ref, scale_ref, dy_ref, gs_ref, dx_ref, dsc_ref,
+                   dbi_ref, *, eps: float, H: int, Hpad: int,
+                   has_gs: bool, out_dtype):
+    """LN input-gradient + per-block dscale/dbias partials; mean/rstd
+    recomputed in-block (rank-1 per row — cheaper than an HBM
+    round-trip of saved stats).  ``gs`` is the residual-stream cotangent
+    of the fused residual variant, added in the same pass."""
+    s = s_ref[...].astype(jnp.float32)
+    dy = dy_ref[...].astype(jnp.float32)
+    mean, rstd = _ln_stats(s, H, Hpad, eps)
+    xhat = (s - mean) * rstd
+    dxhat = dy * scale_ref[...].astype(jnp.float32)
+    if Hpad != H:
+        # dy's pad columns are zero by padding, but xhat's are not —
+        # mask the terms that multiply xhat alone.
+        dxhat = jnp.where(_col_mask(dxhat.shape, H), dxhat, 0.0)
+    m1 = jnp.sum(dxhat, axis=-1, keepdims=True) / H
+    m2 = jnp.sum(dxhat * xhat, axis=-1, keepdims=True) / H
+    dx = (dxhat - m1 - xhat * m2) * rstd
+    if has_gs:
+        dx = dx + gs_ref[...].astype(jnp.float32)
+    dx_ref[...] = dx.astype(out_dtype)
+    dsc_ref[...] = jnp.sum(dy * xhat, axis=0, keepdims=True)
+    dbi_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
+
+
+def _ln_forward(x, delta, scale, bias, eps: float):
+    """Shared fwd driver: returns (s, y) — s is x when no residual."""
+    shape, dtype = x.shape, x.dtype
+    H = shape[-1]
+    rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
+    has_resid = delta is not None
+    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=6 if has_resid else 5)
+    x2 = _pad2(x.reshape(rows, H), rows_pad, Hpad)
+    args = [x2]
+    if has_resid:
+        args.append(_pad2(delta.reshape(rows, H), rows_pad, Hpad))
+    else:
+        args.append(jnp.zeros((1, Hpad), dtype))
+    args.append(_pad_row(scale.astype(jnp.float32), Hpad))
+    args.append(_pad_row(bias.astype(jnp.float32), Hpad))
+    kernel = functools.partial(_ln_fwd_kernel, eps=eps, H=H, Hpad=Hpad,
+                               has_resid=has_resid, out_dtype=dtype)
+    n_out = 2 if has_resid else 1
+    outs = pl.pallas_call(
+        kernel,
+        grid=(rows_pad // rb,),
+        in_specs=[_row_spec(rb, Hpad),
+                  _row_spec(rb, Hpad) if has_resid else _whole_spec(Hpad),
+                  _whole_spec(Hpad), _whole_spec(Hpad)],
+        out_specs=[_row_spec(rb, Hpad)] * n_out,
+        out_shape=[jax.ShapeDtypeStruct((rows_pad, Hpad), dtype)] * n_out,
+        interpret=_interpret(),
+    )(*args)
+    def unpad(a):
+        return a[:rows, :H].reshape(shape)
+    if has_resid:
+        return unpad(outs[0]), unpad(outs[1])
+    return x, unpad(outs[0])
+
+
+def _ln_backward(s, scale, dy, gs, eps: float):
+    """Shared bwd driver: (ds, dscale, dbias)."""
+    shape, dtype = s.shape, s.dtype
+    H = shape[-1]
+    rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
+    has_gs = gs is not None
+    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=7 if has_gs else 6)
+    grid = rows_pad // rb
+    s2 = _pad2(s.reshape(rows, H), rows_pad, Hpad)
+    dy2 = _pad2(dy.reshape(rows, H), rows_pad, Hpad)
+    gs2 = _pad2(gs.reshape(rows, H), rows_pad, Hpad) if has_gs \
+        else jnp.zeros((1, Hpad), dtype)
+    kernel = functools.partial(_ln_bwd_kernel, eps=eps, H=H, Hpad=Hpad,
+                               has_gs=has_gs, out_dtype=dtype)
+    dx, dsc, dbi = pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[_row_spec(rb, Hpad), _whole_spec(Hpad),
+                  _row_spec(rb, Hpad),
+                  _row_spec(rb, Hpad) if has_gs else _whole_spec(Hpad)],
+        out_specs=[_row_spec(rb, Hpad), _part_spec(Hpad),
+                   _part_spec(Hpad)],
+        out_shape=[jax.ShapeDtypeStruct((rows_pad, Hpad), dtype),
+                   jax.ShapeDtypeStruct((grid, Hpad), jnp.float32),
+                   jax.ShapeDtypeStruct((grid, Hpad), jnp.float32)],
+        interpret=_interpret(),
+    )(s2, _pad_row(scale.astype(jnp.float32), Hpad), dy2, gs2)
+    ds = dx[:rows, :H].reshape(shape)
+    dscale = jnp.sum(dsc, axis=0)[:H].astype(scale.dtype)
+    dbias = jnp.sum(dbi, axis=0)[:H].astype(scale.dtype)
+    return ds, dscale, dbias
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def fused_layer_norm(x, scale, bias, eps: float = 1e-5):
+    """LayerNorm over the last axis, fp32 statistics, one fused pass.
+    Drop-in for ``models.transformer.layer_norm``."""
+    return _ln_forward(x, None, scale, bias, eps)[1]
+
+
+def _fln_fwd(x, scale, bias, eps):
+    y = _ln_forward(x, None, scale, bias, eps)[1]
+    return y, (x, scale)
+
+
+def _fln_bwd(eps, res, dy):
+    x, scale = res
+    return _ln_backward(x, scale, dy, None, eps)
+
+
+fused_layer_norm.defvjp(_fln_fwd, _fln_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4,))
+def fused_residual_layer_norm(x, delta, scale, bias, eps: float = 1e-5):
+    """``s = x + delta; y = LN(s)`` in one pass; returns ``(s, y)``.
+
+    ``s`` continues the residual stream, ``y`` feeds the next sublayer —
+    the fusion the reference's ``normalize_invertible`` fused LN
+    performs between every transformer sublayer.
+    """
+    return _ln_forward(x, delta, scale, bias, eps)
+
+
+def _frln_fwd(x, delta, scale, bias, eps):
+    s, y = _ln_forward(x, delta, scale, bias, eps)
+    return (s, y), (s, scale)
+
+
+def _frln_bwd(eps, res, cotangents):
+    s, scale = res
+    gs, gy = cotangents
+    ds, dscale, dbias = _ln_backward(s, scale, gy, gs, eps)
+    # d(x + delta)/dx == d(x + delta)/ddelta == identity: both inputs
+    # receive the same combined cotangent.
+    return ds, ds, dscale, dbias
+
+
+fused_residual_layer_norm.defvjp(_frln_fwd, _frln_bwd)
+
+
+# --------------------------------------------------------------------- #
+# Bias + GELU epilogue
+# --------------------------------------------------------------------- #
+def _gelu_f32(z: jax.Array, exact: bool) -> jax.Array:
+    if exact:
+        return 0.5 * z * (1.0 + lax.erf(z / math.sqrt(2.0)))
+    u = _SQRT_2_OVER_PI * (z + _GELU_C * z * z * z)
+    return 0.5 * z * (1.0 + jnp.tanh(u))
+
+
+def _dgelu_f32(z: jax.Array, exact: bool) -> jax.Array:
+    if exact:
+        phi = jnp.exp(-0.5 * z * z) / math.sqrt(2.0 * math.pi)
+        return 0.5 * (1.0 + lax.erf(z / math.sqrt(2.0))) + z * phi
+    u = _SQRT_2_OVER_PI * (z + _GELU_C * z * z * z)
+    t = jnp.tanh(u)
+    du = _SQRT_2_OVER_PI * (1.0 + 3.0 * _GELU_C * z * z)
+    return 0.5 * (1.0 + t) + 0.5 * z * (1.0 - t * t) * du
+
+
+def _gelu_fwd_kernel(y_ref, b_ref, o_ref, *, exact: bool, out_dtype):
+    z = (y_ref[...].astype(jnp.float32) +
+         b_ref[...].astype(jnp.float32)).astype(out_dtype)
+    o_ref[...] = _gelu_f32(z.astype(jnp.float32), exact).astype(out_dtype)
+
+
+def _gelu_bwd_kernel(y_ref, b_ref, g_ref, dy_ref, db_ref, *, exact: bool,
+                     out_dtype):
+    """dz = g * gelu'(z) with z recomputed from the saved matmul output
+    (no extra residual); db partial = column sum of dz per block."""
+    z = (y_ref[...].astype(jnp.float32) +
+         b_ref[...].astype(jnp.float32)).astype(out_dtype)
+    dz = g_ref[...].astype(jnp.float32) * \
+        _dgelu_f32(z.astype(jnp.float32), exact)
+    dy_ref[...] = dz.astype(out_dtype)
+    db_ref[...] = jnp.sum(dz, axis=0, keepdims=True)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def fused_bias_gelu(y, bias, exact: bool = False):
+    """``gelu(y + bias)`` in one fused pass — the FFN up-projection
+    epilogue (``y`` is the raw matmul output).  ``exact`` selects the
+    erf form; default is the tanh approximation the reference's
+    ``gelu_kernels.cu`` computes (and GPT-2's gelu_new)."""
+    return _gelu_apply(y, bias, exact)
+
+
+def _gelu_apply(y, bias, exact):
+    shape, dtype = y.shape, y.dtype
+    F = shape[-1]
+    rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
+    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=4)
+    y2 = _pad2(y.reshape(rows, F), rows_pad, Fpad)
+    out = pl.pallas_call(
+        functools.partial(_gelu_fwd_kernel, exact=exact, out_dtype=dtype),
+        grid=(rows_pad // rb,),
+        in_specs=[_row_spec(rb, Fpad), _whole_spec(Fpad)],
+        out_specs=_row_spec(rb, Fpad),
+        out_shape=jax.ShapeDtypeStruct((rows_pad, Fpad), dtype),
+        interpret=_interpret(),
+    )(y2, _pad_row(bias.astype(jnp.float32), Fpad))
+    return out[:rows, :F].reshape(shape)
+
+
+def _fbg_fwd(y, bias, exact):
+    return _gelu_apply(y, bias, exact), (y, bias)
+
+
+def _fbg_bwd(exact, res, g):
+    y, bias = res
+    shape, dtype = y.shape, y.dtype
+    F = shape[-1]
+    rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
+    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=5)
+    grid = rows_pad // rb
+    y2 = _pad2(y.reshape(rows, F), rows_pad, Fpad)
+    g2 = _pad2(g.reshape(rows, F), rows_pad, Fpad)
+    dy, dbp = pl.pallas_call(
+        functools.partial(_gelu_bwd_kernel, exact=exact, out_dtype=dtype),
+        grid=(grid,),
+        in_specs=[_row_spec(rb, Fpad), _whole_spec(Fpad),
+                  _row_spec(rb, Fpad)],
+        out_specs=[_row_spec(rb, Fpad), _part_spec(Fpad)],
+        out_shape=[jax.ShapeDtypeStruct((rows_pad, Fpad), dtype),
+                   jax.ShapeDtypeStruct((grid, Fpad), jnp.float32)],
+        interpret=_interpret(),
+    )(y2, _pad_row(bias.astype(jnp.float32), Fpad), g2)
+    dbias = jnp.sum(dbp, axis=0)[:F].astype(bias.dtype)
+    return dy[:rows, :F].reshape(shape), dbias
+
+
+fused_bias_gelu.defvjp(_fbg_fwd, _fbg_bwd)
+
+
+__all__ = ["fused_layer_norm", "fused_residual_layer_norm",
+           "fused_bias_gelu", "fused_elementwise_enabled"]

@@ -1,4 +1,4 @@
-"""Fused Pallas multi-tensor optimizer apply.
+"""Fused Pallas multi-tensor optimizer apply — one HBM pass per step.
 
 Parity target: the reference's multi-tensor fused Adam
 (``csrc/adam/multi_tensor_adam.cu:123``) — ONE kernel pass per chunk that
@@ -11,32 +11,67 @@ param leaf — ~450 kernel launches for an unrolled GPT-2, each re-paying
 launch + pipeline-warmup overhead — and the engine's clip multiply,
 unscale, bias correction and stochastic-rounding write are separate
 HBM passes when XLA's fusion heuristics split them. The Pallas kernel
-makes the single-pass property structural instead of heuristic:
+makes the single-pass property structural instead of heuristic.
 
-    read  grad, param, m, v          (one chunk per grid step, VMEM)
-    g  = grad * clip_coeff           (global-clip folded in, no clip pass)
-    m' = (1-b1)*g + b1*m             (f32, even for bf16 grads — the
-    v' = (1-b2)*g^2 + b2*v            second moment is never squared in
-                                      bf16; reference fp32 accumulators)
-    u  = -lr * (m'/bc1 / (sqrt(v'/bc2) + eps) + wd*p)
-    write param+u (optionally via unbiased stochastic rounding to bf16
-    — the master-free mode of ops/stochastic_rounding.py, done in-kernel
-    from a hash-counter PRNG so no noise tensor ever touches HBM), m', v'
+Two entry points share the kernel:
 
-The multi-tensor front end flattens the pytree's float leaves into
-contiguous same-dtype chunk buffers (the moral equivalent of the CUDA
-chunked apply); the optimizer state stores the moments *already fused*
-(one f32 buffer per dtype group), so only grads/params pay the
-flatten/unflatten passes.
+- ``fused_apply`` (PR-1 API, kept verbatim): the caller has already
+  resolved the clip coefficient and the overflow vote; the kernel folds
+  the coefficient into its grad read. The engine's historical "two-pass"
+  path: a separate full-tree norm read precedes the apply.
+- ``fused_step`` (the one-pass path): the global-norm reduction, fp16
+  unscale, overflow vote, clip, overflow-skip select, and the
+  compute-dtype cast-cache refresh ALL ride inside the fused pass:
 
-The deterministic path is bit-exact with ``optax.adamw`` / the engine's
+      kernel 1 (per chunk): sq-norm partials of the flat grads
+      scalar carry:         norm = sqrt(psum partials) / scale
+                            overflow = !isfinite(norm)   [fp16]
+                            coeff = min(1, clip/(norm+1e-6))
+      kernel 2 (per chunk): read g,p,m,v; g = (g*inv)*coeff
+                            m',v' Adam update (f32 moments)
+                            skip-select (overflow holds the step)
+                            write p' (+ optional compute-dtype cast copy,
+                            + optional in-kernel bf16 stochastic round)
+
+  so optimizer state (param+m+v) is read and written exactly ONCE per
+  step: no separate norm pass, no full-tree unscale multiply, no
+  post-apply jnp.where overflow select, no post-apply cast pass.
+
+Multi-tensor layout (V-interleaved, ZeRO-shard-local)
+-----------------------------------------------------
+
+The pytree's float leaves flatten into contiguous same-dtype buffers.
+PR-1 concatenated leaves end to end, which made every per-device flat
+chunk a FULL-tree buffer under ZeRO sharding (GSPMD gathered the
+dp-sharded moments around the opaque kernel — COMM_AUDIT.json's
+``fused_chunk_gather`` finding). The layout is now *virtual-shard
+interleaved*: each leaf is padded to a multiple of ``V`` virtual shards
+and reshaped to ``[V, r_leaf]``; leaves concatenate along axis 1 into a
+``[V, L]`` group buffer (stored flat as ``[V*L]``). Row v holds the
+v-th 1/V slice of every leaf, so:
+
+- a contiguous 1/dp range of the flat buffer == ``V/dp`` whole rows ==
+  the dp-shard of every leaf (any dp dividing V);
+- the kernels run under ``shard_map`` over the dp axis on LOCAL rows —
+  the moments are never gathered, each device updates exactly its ZeRO
+  shard, and the updated params leave the region dp-sharded (the
+  engine's replicated out_shardings turn that into the per-leaf ZeRO-2
+  param all-gather);
+- the layout does not depend on dp (``V`` is a constant 8, widened to
+  dp only above 8 devices), so checkpoints stay elastic across dp
+  resizes exactly like PR-1's.
+
+The deterministic math is bit-exact with ``optax.adamw`` / the engine's
 coupled-Adam chain: every multiply-add is written in optax's association
-order (see ``tests/test_fused_update.py``).
+order (see ``tests/test_fused_update.py``). The one-pass norm is the
+same sum-of-squares at a different association (chunk partials instead
+of per-leaf sums), so clip coefficients agree to f32 ulp — the same
+cross-program tolerance class PR-1 documented for FMA contraction.
 """
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, NamedTuple, Optional, Tuple, Union
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -50,30 +85,51 @@ except Exception:  # pragma: no cover
 
 ScheduleOrFloat = Union[Callable, float]
 
-# Chunk geometry: W lanes wide (128-multiple), R sublane rows per grid
-# step. One (R, W) f32 block is 512 KiB; with 4 inputs + 3 outputs double
-# buffered that is ~7 MiB of VMEM — inside the ~16 MiB/core budget.
+# Kernel geometry: W lanes wide (128-multiple), up to _R sublane rows per
+# grid step. One (128, 1024) f32 block is 512 KiB; with 4 inputs + up to
+# 4 outputs double buffered that is ~8 MiB of VMEM — inside the ~16
+# MiB/core budget.
 _W = 1024
 _R = 128
-_CHUNK = _R * _W   # elements per grid step; buffers pad to a multiple
+# Group rows pad to a multiple of 8*_W elements so the per-shard row
+# count is always a multiple of the f32 minimum sublane tile (8).
+_ROW_QUANTUM = 8 * _W
+
+# Virtual shard count: the flat layout interleaves every leaf over _V
+# rows, so any dp <= _V owns whole rows (= contiguous flat ranges) and
+# the layout itself never depends on the live dp size (checkpoint
+# elasticity). Meshes wider than _V widen V to dp — sizes above 8 are
+# beyond this repo's test envelope and noted in docs/tutorials/kernels.md.
+_V = 8
 
 
 class FusedAdamState(NamedTuple):
-    """Fused optimizer state: one f32 moment buffer per dtype group.
-
-    The moments live *pre-flattened* — only grads and params pay the
-    per-step flatten/unflatten. Buffers are padded to a _CHUNK multiple,
-    which keeps them divisible by any practical dp size so ZeRO
-    shardings (zero/partition.py) split them on axis 0 and checkpoint
-    shards stay elastic across dp resizes.
-    """
+    """Fused optimizer state: one flat f32 moment buffer per dtype group,
+    stored in the V-interleaved layout (see module docstring). ZeRO
+    shardings (zero/partition.py) split the flat axis over dp; any dp
+    dividing V lands on whole virtual rows, so shards are element-aligned
+    with the grads/params the kernel reads and checkpoint shards stay
+    elastic across dp resizes."""
     count: jax.Array                 # int32 scalar, number of updates
     m: Tuple[jax.Array, ...]
     v: Tuple[jax.Array, ...]
 
 
+class FusedStepOut(NamedTuple):
+    """Everything the one-pass ``fused_step`` produces."""
+    params: Any
+    state: "FusedAdamState"
+    cast_params: Any                 # compute-dtype copy (None when unused)
+    grad_norm: jax.Array             # unscaled global norm (-1.0 = skipped)
+    overflow: jax.Array              # bool (False when not fp16)
+
+
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+def virtual_shards(dp: int = 1) -> int:
+    return max(_V, int(dp))
 
 
 def _float_groups(leaves):
@@ -87,16 +143,91 @@ def _float_groups(leaves):
     return sorted(groups.items(), key=lambda kv: kv[0].name)
 
 
-def _pad_to_chunk(n: int) -> int:
-    return max(_CHUNK, ((n + _CHUNK - 1) // _CHUNK) * _CHUNK)
+def _leaf_rows(n: int, shards: int) -> int:
+    """Per-virtual-shard row length of a leaf (leaf padded to V|n)."""
+    return -(-int(n) // shards)
 
 
-def _flatten_group(leaves, idxs, dtype, npad: int) -> jax.Array:
-    flats = [leaves[i].reshape(-1).astype(dtype) for i in idxs]
-    n = sum(f.size for f in flats)
-    if npad > n:
-        flats.append(jnp.zeros((npad - n,), dtype))
-    return jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+def _group_row_len(sizes, shards: int) -> int:
+    """Padded per-row length L of a group buffer: sum of leaf rows,
+    padded so every 1/V row is a whole number of (8, _W) f32 tiles."""
+    L = sum(_leaf_rows(n, shards) for n in sizes)
+    return max(_ROW_QUANTUM, -(-L // _ROW_QUANTUM) * _ROW_QUANTUM)
+
+
+def group_nbytes(sizes, shards: int = _V, itemsize: int = 4) -> int:
+    """Padded group-buffer bytes (one moment buffer) — the analytic
+    footprint tools use."""
+    return virtual_shards(shards) * _group_row_len(sizes, shards) * itemsize
+
+
+def _flatten_group(leaves, idxs, dtype, shards: int, Lpad: int,
+                   constrain=None) -> jax.Array:
+    """Leaves -> the [shards, Lpad] V-interleaved group buffer.
+
+    Each leaf reshapes to [shards, r_leaf] and the rows concatenate along
+    axis 1 — the concat axis is NOT the sharded axis, so GSPMD partitions
+    the assembly row-locally (no full-buffer materialization; the per-
+    leaf reshard is bounded by that leaf's size). ``constrain`` is the
+    optional NamedSharding pinning rows to the dp axis."""
+    cols = []
+    for i in idxs:
+        f = leaves[i].reshape(-1).astype(dtype)
+        r = _leaf_rows(f.size, shards)
+        if r * shards > f.size:
+            f = jnp.concatenate([f, jnp.zeros((r * shards - f.size,),
+                                              dtype)])
+        a = f.reshape(shards, r)
+        if constrain is not None:
+            a = lax.with_sharding_constraint(a, constrain)
+        cols.append(a)
+    L = sum(a.shape[1] for a in cols)
+    if Lpad > L:
+        tail = jnp.zeros((shards, Lpad - L), dtype)
+        if constrain is not None:
+            tail = lax.with_sharding_constraint(tail, constrain)
+        cols.append(tail)
+    buf = jnp.concatenate(cols, axis=1) if len(cols) > 1 else cols[0]
+    if constrain is not None:
+        buf = lax.with_sharding_constraint(buf, constrain)
+    return buf
+
+
+def _unflatten_group(buf: jax.Array, like_leaves, idxs,
+                     shards: int) -> Dict[int, jax.Array]:
+    """[shards, Lpad] group buffer -> {leaf idx: leaf-shaped array}.
+    Slices stay on the (sharded-safe) row axis; each leaf re-gathers at
+    most its own size downstream."""
+    out: Dict[int, jax.Array] = {}
+    off = 0
+    for i in idxs:
+        n = int(like_leaves[i].size)
+        r = _leaf_rows(n, shards)
+        piece = lax.slice(buf, (0, off), (shards, off + r)).reshape(-1)
+        out[i] = piece[:n].reshape(like_leaves[i].shape)
+        off += r
+    return out
+
+
+def leaf_moment_views(state: "FusedAdamState", params: Any,
+                      shards: int = _V) -> Tuple[Any, Any]:
+    """Per-leaf views of the fused moment buffers (tests / debugging):
+    returns (m_tree, v_tree) shaped like ``params``' float leaves (None
+    at non-float positions)."""
+    p_leaves, treedef = jax.tree_util.tree_flatten(params)
+    shards = virtual_shards(shards)
+    m_out: List[Any] = [None] * len(p_leaves)
+    v_out: List[Any] = [None] * len(p_leaves)
+    for gi, (dt, idxs) in enumerate(_float_groups(p_leaves)):
+        Lpad = _group_row_len([p_leaves[i].size for i in idxs], shards)
+        m2 = state.m[gi].reshape(shards, Lpad)
+        v2 = state.v[gi].reshape(shards, Lpad)
+        for i, a in _unflatten_group(m2, p_leaves, idxs, shards).items():
+            m_out[i] = a
+        for i, a in _unflatten_group(v2, p_leaves, idxs, shards).items():
+            v_out[i] = a
+    return (jax.tree_util.tree_unflatten(treedef, m_out),
+            jax.tree_util.tree_unflatten(treedef, v_out))
 
 
 def _hash_u32(x: jax.Array) -> jax.Array:
@@ -109,16 +240,39 @@ def _hash_u32(x: jax.Array) -> jax.Array:
     return x ^ (x >> jnp.uint32(16))
 
 
-def _fused_adam_kernel(scal_ref, seed_ref, g_ref, p_ref, m_ref, v_ref,
-                       p_out, m_out, v_out, *, b1: float, b2: float,
-                       eps: float, wd: float, coupled: bool,
-                       scale_grads: bool, sr: bool, out_dtype):
-    """One chunk of the fused apply. scal_ref (SMEM, f32 [1,4]):
-    [neg_lr, bias_corr1, bias_corr2, grad_scale]; seed_ref (SMEM, int32
-    [1,1]): stochastic-rounding seed. Math follows optax's association
-    order exactly (bit parity on the deterministic path)."""
+# --------------------------------------------------------------------- #
+# Kernels
+# --------------------------------------------------------------------- #
+def _sqnorm_kernel(g_ref, out_ref):
+    """Per-chunk squared-norm partial: replaces the separate full-tree
+    ``global_norm`` read (and, via isfinite(norm), the full-tree
+    ``tree_has_inf_or_nan`` read) of the two-pass path. Pad regions are
+    zero by construction and contribute nothing."""
     g = g_ref[...].astype(jnp.float32)
-    if scale_grads:
+    s = jnp.sum(g * g)
+    out_ref[...] = jnp.broadcast_to(s, out_ref.shape)
+
+
+def _fused_adam_kernel(scal_ref, seed_ref, g_ref, p_ref, m_ref, v_ref,
+                       *out_refs, b1: float, b2: float, eps: float,
+                       wd: float, coupled: bool, use_inv: bool,
+                       use_coeff: bool, one_pass: bool, sr: bool,
+                       cast: bool, out_dtype, cast_dtype):
+    """One chunk of the fused apply.
+
+    scal_ref (SMEM, f32 [1,8]): [neg_lr, bias_corr1, bias_corr2, coeff,
+    inv_scale, skip, 0, 0]; seed_ref (SMEM, int32 [1,2]): [sr seed,
+    global base element index]. Math follows optax's association order
+    exactly (bit parity on the deterministic path); the fp16 unscale and
+    the clip multiply are SEPARATE multiplies, preserving the historical
+    ``(g*inv)*coeff`` association of the two-pass engine path."""
+    p_out = out_refs[0]
+    m_out, v_out = out_refs[1], out_refs[2]
+    cast_out = out_refs[3] if cast else None
+    g = g_ref[...].astype(jnp.float32)
+    if use_inv:
+        g = g * scal_ref[0, 4]
+    if use_coeff:
         g = g * scal_ref[0, 3]
     p32 = p_ref[...].astype(jnp.float32)
     if coupled and wd:
@@ -132,18 +286,30 @@ def _fused_adam_kernel(scal_ref, seed_ref, g_ref, p_ref, m_ref, v_ref,
     if (not coupled) and wd:
         u = u + wd * p32
     new_p = p32 + u * scal_ref[0, 0]
+    if one_pass:
+        # Overflow-skip folded into the pass: the old params/moments are
+        # already in VMEM, so holding the step costs a register select
+        # instead of the engine's post-apply full-tree jnp.where pass.
+        keep_old = scal_ref[0, 5] > 0.0
+        new_p = jnp.where(keep_old, p32, new_p)
+        m = jnp.where(keep_old, m_ref[...], m)
+        v = jnp.where(keep_old, v_ref[...], v)
     m_out[...] = m
     v_out[...] = v
+    if cast:
+        cast_out[...] = new_p.astype(cast_dtype)
     if sr:
         # In-kernel unbiased stochastic rounding to bf16 (the master-free
         # mode): add uniform 16-bit noise to the f32 mantissa tail, then
         # truncate — E[round(x)] == x (see ops/stochastic_rounding.py).
-        # Noise comes from a counter hash of the global element index, so
-        # it costs zero HBM traffic and is reproducible per (seed, index).
+        # Noise comes from a counter hash of the GLOBAL element index
+        # (seed_ref[0,1] carries the shard's base offset), so it costs
+        # zero HBM traffic and is reproducible per (seed, index).
         R, W = new_p.shape
         rows = lax.broadcasted_iota(jnp.uint32, (R, W), 0)
         cols = lax.broadcasted_iota(jnp.uint32, (R, W), 1)
-        idx = (pl.program_id(0).astype(jnp.uint32) * jnp.uint32(R) + rows) \
+        idx = seed_ref[0, 1].astype(jnp.uint32) + \
+            (pl.program_id(0).astype(jnp.uint32) * jnp.uint32(R) + rows) \
             * jnp.uint32(W) + cols
         noise = _hash_u32(idx ^ seed_ref[0, 0].astype(jnp.uint32)) \
             & jnp.uint32(0xFFFF)
@@ -165,34 +331,67 @@ def _smem_spec(shape):
     return pl.BlockSpec(shape, lambda i: (0, 0))
 
 
-def _chunk_spec():
+def _chunk_spec(rb: int):
     if pltpu is not None and jax.default_backend() == "tpu":
-        return pl.BlockSpec((_R, _W), lambda i: (i, 0),
+        return pl.BlockSpec((rb, _W), lambda i: (i, 0),
                             memory_space=pltpu.VMEM)
-    return pl.BlockSpec((_R, _W), lambda i: (i, 0))
+    return pl.BlockSpec((rb, _W), lambda i: (i, 0))
+
+
+def _block_rows(rows: int) -> int:
+    """Largest power-of-two row count <= _R dividing ``rows`` (rows is
+    always a multiple of 8 by the _ROW_QUANTUM padding)."""
+    rb = _R
+    while rb > 8 and rows % rb:
+        rb //= 2
+    assert rows % rb == 0, (rows, rb)
+    return rb
+
+
+def _run_sqnorm(gflat: jax.Array) -> jax.Array:
+    """Squared norm of one flat group buffer via per-chunk partials."""
+    rows = gflat.size // _W
+    rb = _block_rows(rows)
+    grid = rows // rb
+    out = pl.pallas_call(
+        _sqnorm_kernel,
+        grid=(grid,),
+        in_specs=[_chunk_spec(rb)],
+        out_specs=pl.BlockSpec((1, 128), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((grid, 128), jnp.float32),
+        interpret=_interpret(),
+    )(gflat.reshape(rows, _W))
+    return jnp.sum(out[:, 0])
 
 
 def _run_group(gflat, pflat, m, v, scalars, seed, *, b1, b2, eps, wd,
-               coupled, scale_grads, sr, out_dtype):
-    """Run the kernel over one fused dtype-group buffer [Npad]."""
-    npad = gflat.size
-    rows = npad // _W
+               coupled, use_inv, use_coeff, one_pass, sr, cast,
+               out_dtype, cast_dtype):
+    """Run the fused kernel over one flat group buffer (local shard when
+    shard-mapped). Returns (p_new, m_new, v_new, cast_new_or_None)."""
+    rows = gflat.size // _W
+    rb = _block_rows(rows)
     shape2 = (rows, _W)
     kernel = functools.partial(
         _fused_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd, coupled=coupled,
-        scale_grads=scale_grads, sr=sr, out_dtype=out_dtype)
-    p_new, m_new, v_new = pl.pallas_call(
+        use_inv=use_inv, use_coeff=use_coeff, one_pass=one_pass, sr=sr,
+        cast=cast, out_dtype=out_dtype, cast_dtype=cast_dtype)
+    out_specs = [_chunk_spec(rb)] * (4 if cast else 3)
+    out_shape = [
+        jax.ShapeDtypeStruct(shape2, out_dtype),
+        jax.ShapeDtypeStruct(shape2, jnp.float32),
+        jax.ShapeDtypeStruct(shape2, jnp.float32),
+    ]
+    if cast:
+        out_shape.append(jax.ShapeDtypeStruct(shape2, cast_dtype))
+    outs = pl.pallas_call(
         kernel,
-        grid=(rows // _R,),
-        in_specs=[_smem_spec((1, 4)), _smem_spec((1, 1)),
-                  _chunk_spec(), _chunk_spec(), _chunk_spec(),
-                  _chunk_spec()],
-        out_specs=[_chunk_spec(), _chunk_spec(), _chunk_spec()],
-        out_shape=[
-            jax.ShapeDtypeStruct(shape2, out_dtype),
-            jax.ShapeDtypeStruct(shape2, jnp.float32),
-            jax.ShapeDtypeStruct(shape2, jnp.float32),
-        ],
+        grid=(rows // rb,),
+        in_specs=[_smem_spec((1, 8)), _smem_spec((1, 2)),
+                  _chunk_spec(rb), _chunk_spec(rb), _chunk_spec(rb),
+                  _chunk_spec(rb)],
+        out_specs=out_specs,
+        out_shape=out_shape,
         # In-place update: p/m/v inputs alias the outputs (same
         # shape+dtype when the param dtype matches; m/v always), so the
         # kernel never holds two copies of the moments in HBM.
@@ -202,13 +401,78 @@ def _run_group(gflat, pflat, m, v, scalars, seed, *, b1, b2, eps, wd,
         interpret=_interpret(),
     )(scalars, seed, gflat.reshape(shape2), pflat.reshape(shape2),
       m.reshape(shape2), v.reshape(shape2))
-    return p_new.reshape(-1), m_new.reshape(-1), v_new.reshape(-1)
+    p_new, m_new, v_new = outs[0], outs[1], outs[2]
+    cast_new = outs[3] if cast else None
+    return (p_new.reshape(-1), m_new.reshape(-1), v_new.reshape(-1),
+            None if cast_new is None else cast_new.reshape(-1))
+
+
+def apply_hbm_bytes(params: Any, *, one_pass: bool = True,
+                    cast_dtype=None, fp16: bool = False,
+                    clip: bool = True) -> Dict[str, int]:
+    """Analytic HBM bytes one optimizer step's APPLY phase moves, per
+    replica (monitor/cost_model.py prices the apply path with this; the
+    roofline record carries both modes).
+
+    Honest accounting — only passes the historical two-pass engine
+    REALLY paid are priced, and the one-pass side pays for what it
+    really runs:
+
+    - Both modes share the apply kernel's read g(f32)+p+m+v, write
+      p+m+v (+ the compute-dtype cast-copy write).
+    - When a norm is needed (``clip`` or ``fp16``), BOTH modes re-read
+      the grads once more: the two-pass path as the separate
+      ``global_norm`` pass, the one-pass path as the ``_run_sqnorm``
+      kernel — a wash in bytes (the one-pass win there is launches and
+      the scalar plumbing, not HBM).
+    - fp16 only: the two-pass path's unscale (read+write g), the
+      ``tree_has_inf_or_nan`` re-read of g, and the post-apply overflow
+      select (read old p+m+v, read new p+m+v, write the selection) are
+      real traced passes.  For non-fp16 runs ``overflow`` was a
+      compile-time constant and XLA folded the select to nothing — no
+      saving is claimed there.
+    - cast_dtype only: the standalone cast pass re-READS the updated
+      params (the cast write itself exists in both modes).
+
+    Consequence: the drop is ~2.5x for fp16 configs, ~1.1x for
+    fp32-master + cast-cache bf16 configs, and ~1.0x for master-free
+    bf16 (where the one-pass path's value is fewer launches, not fewer
+    bytes) — stated plainly in docs/tutorials/kernels.md.
+    """
+    leaves = [l for l in jax.tree_util.tree_leaves(params)
+              if hasattr(l, "dtype") and
+              jnp.issubdtype(l.dtype, jnp.floating)]
+    n = sum(int(l.size) for l in leaves)
+    p_bytes = sum(int(l.size) * jnp.dtype(l.dtype).itemsize
+                  for l in leaves)
+    g_bytes = 4 * n                       # grads flatten in f32
+    mv_bytes = 2 * 4 * n                  # f32 moments
+    cast_bytes = (n * jnp.dtype(cast_dtype).itemsize) if cast_dtype else 0
+    kernel = g_bytes + p_bytes + mv_bytes + p_bytes + mv_bytes + cast_bytes
+    need_norm = bool(clip) or fp16
+    norm_read = g_bytes if need_norm else 0
+    one = kernel + norm_read
+    two = kernel + norm_read
+    if fp16:
+        two += 2 * g_bytes                # unscale: read + write g
+        two += g_bytes                    # tree_has_inf_or_nan re-read
+        # overflow select (REAL only under fp16): read old + new p/m/v,
+        # write the selected state
+        two += 3 * (p_bytes + mv_bytes)
+    if cast_dtype:
+        two += p_bytes                    # cast pass re-reads new params
+    out = {"one_pass": one, "two_pass": two}
+    out["active"] = one if one_pass else two
+    out["ratio_two_over_one"] = round(two / max(1, one), 3)
+    return out
 
 
 def fused_adam(learning_rate: ScheduleOrFloat, b1: float = 0.9,
                b2: float = 0.999, eps: float = 1e-8,
                weight_decay: float = 0.0, adam_w_mode: bool = True,
-               multi_tensor: bool = True) -> "FusedGradientTransformation":
+               multi_tensor: bool = True, mesh=None,
+               shard_axis: Optional[str] = None
+               ) -> "FusedGradientTransformation":
     """Build the fused-apply transformation.
 
     ``adam_w_mode=True`` matches ``optax.adamw`` (decoupled decay);
@@ -217,15 +481,31 @@ def fused_adam(learning_rate: ScheduleOrFloat, b1: float = 0.9,
     kernel launch per leaf instead of chunked fused buffers — kept for
     the ablation ladder (``ablate_fused_update.py``), not production.
 
-    Returned object is optax-compatible (``init``/``update``) and carries
-    the single-pass entry point ``fused_apply(grads, state, params,
-    clip_coeff=None, sr_key=None) -> (new_params, new_state)`` that the
-    engine's train steps call directly: it folds the global-clip
-    coefficient into the kernel (no separate clip pass) and, given
-    ``sr_key``, rounds bf16 params stochastically in-kernel.
+    ``mesh`` + ``shard_axis`` (engine-provided under ZeRO stage >= 1 on
+    a pure-dp mesh) run the kernels under ``shard_map`` over the dp
+    axis: every buffer enters as its LOCAL virtual-shard rows, the
+    moments are never gathered, and the norm partials ``psum`` into the
+    global norm. Without them the kernels run on the full buffers (dp=1,
+    or bare transform use).
+
+    Returned object is optax-compatible (``init``/``update``) and
+    carries two fused entry points: ``fused_apply`` (PR-1 API: caller
+    resolves clip/overflow) and ``fused_step`` (one-pass: norm, clip,
+    fp16 unscale, overflow vote+skip, cast-cache refresh all inside the
+    single HBM pass — see module docstring).
     """
     sched = learning_rate if callable(learning_rate) else None
     base_lr = None if sched is not None else float(learning_rate)
+    dp = int(mesh.shape[shard_axis]) if (mesh is not None and
+                                         shard_axis is not None) else 1
+    shards = virtual_shards(dp)
+    use_shard_map = dp > 1 and shards % dp == 0
+
+    def _row_sharding():
+        if mesh is None or shard_axis is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return NamedSharding(mesh, P(shard_axis, None))
 
     def _leaves(params):
         return jax.tree_util.tree_flatten(params)
@@ -235,94 +515,298 @@ def fused_adam(learning_rate: ScheduleOrFloat, b1: float = 0.9,
         groups = _float_groups(leaves)
         bufs = []
         for _, idxs in groups:
-            n = sum(int(leaves[i].size) for i in idxs)
-            npad = _pad_to_chunk(n) if multi_tensor else None
+            sizes = [int(leaves[i].size) for i in idxs]
             if multi_tensor:
-                bufs.append(jnp.zeros((npad,), jnp.float32))
+                Lpad = _group_row_len(sizes, shards)
+                bufs.append(jnp.zeros((shards * Lpad,), jnp.float32))
             else:
                 # per-leaf mode: one moment buffer per leaf, each padded
-                # to a whole chunk (tiny leaves burn a full chunk — the
-                # launch-amortization problem multi-tensor mode fixes).
+                # to its own whole-row quantum (tiny leaves burn a full
+                # quantum — the launch-amortization problem multi-tensor
+                # mode fixes).
                 bufs.append(tuple(
-                    jnp.zeros((_pad_to_chunk(int(leaves[i].size)),),
-                              jnp.float32) for i in idxs))
+                    jnp.zeros((shards * _group_row_len([n], shards),),
+                              jnp.float32) for n in sizes))
         return FusedAdamState(count=jnp.zeros([], jnp.int32),
                               m=tuple(bufs),
                               v=jax.tree_util.tree_map(jnp.zeros_like,
                                                        tuple(bufs)))
 
-    def _scalars(count, clip_coeff):
+    def _base_scalars(count, inv_scale):
+        """The scalar carry every path shares: [neg_lr, bc1, bc2, inv].
+        Bit parity: these are the exact expressions optax evaluates
+        (python-float ** int32 array -> f32 power; see
+        optax.tree_utils.tree_bias_correction)."""
         count_inc = count + 1
-        # Bit parity: these are the exact expressions optax evaluates
-        # (python-float ** int32 array → f32 power; see
-        # optax.tree_utils.tree_bias_correction).
         bc1 = (1 - b1 ** count_inc).astype(jnp.float32)
         bc2 = (1 - b2 ** count_inc).astype(jnp.float32)
         lr = sched(count) if sched is not None else base_lr
         neg_lr = jnp.asarray(-1.0, jnp.float32) * jnp.asarray(
             lr, jnp.float32)
-        gscale = jnp.asarray(1.0, jnp.float32) if clip_coeff is None \
-            else jnp.asarray(clip_coeff, jnp.float32)
-        return jnp.stack([neg_lr, bc1, bc2, gscale]).reshape(1, 4), count_inc
+        inv = jnp.asarray(1.0, jnp.float32) if inv_scale is None \
+            else jnp.asarray(inv_scale, jnp.float32)
+        return jnp.stack([neg_lr, bc1, bc2, inv])
 
-    def _apply(grads, state, params, clip_coeff=None, sr_key=None):
+    def _group_plan(p_leaves):
+        """[(group idx, dtype, leaf idxs, sizes, Lpad)] for the tree."""
+        plan = []
+        for gi, (dt, idxs) in enumerate(_float_groups(p_leaves)):
+            sizes = [int(p_leaves[i].size) for i in idxs]
+            plan.append((gi, dt, idxs, sizes,
+                         _group_row_len(sizes, shards)))
+        return plan
+
+    def _kernel_region(base, seed0, pre_coeff, extra_skip, gbufs, pbufs,
+                       ms, vs, *, plan, clip, fp16, use_inv, one_pass,
+                       compute_norm, has_pre_coeff, use_extra_skip,
+                       sr_groups, cast_groups, cast_dtype, local):
+        """Norm + apply kernels over (possibly shard-local) group
+        buffers. Runs inside shard_map when ``local``; all inputs are
+        then the device's own virtual rows. ``cast_groups`` marks which
+        groups emit a compute-dtype cast output (static, so the cast
+        tuple's pytree shape is fixed)."""
+        axis = shard_axis if local else None
+        if compute_norm:
+            nsq = jnp.float32(0.0)
+            for g in gbufs:
+                nsq = nsq + _run_sqnorm(g.reshape(-1))
+            if axis is not None:
+                nsq = lax.psum(nsq, axis)
+            # norm of the UNSCALED grads: ||g*inv|| == inv * ||g||.
+            grad_norm = jnp.sqrt(nsq) * base[3]
+        else:
+            grad_norm = jnp.asarray(-1.0, jnp.float32)
+        if fp16:
+            # inf/nan anywhere in the grads surfaces as a non-finite
+            # sum of squares — the norm read doubles as the overflow
+            # vote (reference CheckOverflow semantics, one pass).
+            overflow = jnp.logical_not(jnp.isfinite(grad_norm))
+        else:
+            overflow = jnp.asarray(False)
+        if use_extra_skip:
+            overflow = jnp.logical_or(overflow, extra_skip)
+        if compute_norm and clip and clip > 0:
+            # Same expression as runtime.utils.clip_coefficient (kept
+            # textually identical so the paths cannot diverge).
+            coeff = jnp.minimum(1.0, clip / (grad_norm + 1e-6))
+            use_coeff = True
+        elif has_pre_coeff:
+            coeff = pre_coeff.astype(jnp.float32)
+            use_coeff = True
+        else:
+            coeff = jnp.asarray(1.0, jnp.float32)
+            use_coeff = False
+        skip = jnp.where(overflow, 1.0, 0.0).astype(jnp.float32)
+        # SMEM scalar row: [neg_lr, bc1, bc2, coeff, inv, skip, 0, 0].
+        scalars = jnp.stack(
+            [base[0], base[1], base[2], coeff, base[3], skip,
+             jnp.float32(0.0), jnp.float32(0.0)])[None]
+        new_p, new_m, new_v, new_cast = [], [], [], []
+        for k, (gi, dt, idxs, sizes, Lpad) in enumerate(plan):
+            sr = sr_groups[k]
+            nloc = int(gbufs[k].size)
+            if axis is not None:
+                off = lax.axis_index(axis).astype(jnp.int32) * \
+                    jnp.int32(nloc)
+            else:
+                off = jnp.int32(0)
+            seed = jnp.stack([seed0 + jnp.int32(gi), off])[None]
+            pf, mn, vn, cf = _run_group(
+                gbufs[k].reshape(-1), pbufs[k].reshape(-1),
+                ms[k].reshape(-1), vs[k].reshape(-1), scalars, seed,
+                b1=b1, b2=b2, eps=eps, wd=weight_decay,
+                coupled=not adam_w_mode, use_inv=use_inv,
+                use_coeff=use_coeff, one_pass=one_pass, sr=sr,
+                cast=cast_groups[k], out_dtype=dt, cast_dtype=cast_dtype)
+            shape = gbufs[k].shape
+            new_p.append(pf.reshape(shape))
+            new_m.append(mn.reshape(shape))
+            new_v.append(vn.reshape(shape))
+            if cast_groups[k]:
+                new_cast.append(cf.reshape(shape))
+        return (tuple(new_p), tuple(new_m), tuple(new_v),
+                tuple(new_cast), grad_norm, overflow)
+
+    def _apply_impl(grads, state, params, *, pre_coeff=None,
+                    inv_scale=None, clip=0.0, fp16=False,
+                    compute_norm=False, extra_skip=None, one_pass=False,
+                    sr_key=None, cast_dtype=None):
         if params is None:
             raise ValueError("fused_adam requires params")
+        if not multi_tensor:
+            return _apply_per_leaf(grads, state, params,
+                                   pre_coeff=pre_coeff, sr_key=sr_key)
         p_leaves, treedef = _leaves(params)
         g_leaves = treedef.flatten_up_to(grads)
-        groups = _float_groups(p_leaves)
-        scalars, count_inc = _scalars(state.count, clip_coeff)
+        plan = _group_plan(p_leaves)
+        base = _base_scalars(state.count, inv_scale)
         seed0 = jax.random.bits(sr_key, (), jnp.uint32).astype(jnp.int32) \
             if sr_key is not None else jnp.zeros((), jnp.int32)
+        constrain = _row_sharding() if use_shard_map else None
+        gbufs, pbufs, ms, vs = [], [], [], []
+        sr_groups, cast_groups = [], []
+        for gi, dt, idxs, sizes, Lpad in plan:
+            # Grads flatten in f32, NOT the param dtype: master-free
+            # engines hand in f32-accumulated grads over bf16 params,
+            # and truncating them here would defeat the kernel's
+            # f32-second-moment guarantee before it ever reads them.
+            gbufs.append(_flatten_group(g_leaves, idxs, jnp.float32,
+                                        shards, Lpad, constrain))
+            pbufs.append(_flatten_group(p_leaves, idxs, dt, shards,
+                                        Lpad, constrain))
+            m2 = state.m[gi].reshape(shards, Lpad)
+            v2 = state.v[gi].reshape(shards, Lpad)
+            if constrain is not None:
+                m2 = lax.with_sharding_constraint(m2, constrain)
+                v2 = lax.with_sharding_constraint(v2, constrain)
+            ms.append(m2)
+            vs.append(v2)
+            sr = sr_key is not None and dt == jnp.dtype(jnp.bfloat16)
+            sr_groups.append(sr)
+            cast_groups.append(cast_dtype is not None and not sr and
+                               jnp.dtype(cast_dtype) != dt)
+        pre_coeff_arr = jnp.asarray(
+            1.0 if pre_coeff is None else pre_coeff, jnp.float32)
+        extra_skip_arr = jnp.asarray(
+            False if extra_skip is None else extra_skip)
+        region = functools.partial(
+            _kernel_region, plan=plan, clip=clip, fp16=fp16,
+            use_inv=inv_scale is not None, one_pass=one_pass,
+            compute_norm=compute_norm,
+            has_pre_coeff=pre_coeff is not None,
+            use_extra_skip=extra_skip is not None,
+            sr_groups=tuple(sr_groups), cast_groups=tuple(cast_groups),
+            cast_dtype=cast_dtype, local=use_shard_map)
+        if use_shard_map:
+            from jax.sharding import PartitionSpec as P
+            from ..parallel.comm import shard_map
+            row = P(shard_axis, None)
+            nbuf = len(plan)
+            ncast = sum(1 for c in cast_groups if c)
+            fn = shard_map(
+                region, mesh=mesh,
+                in_specs=(P(), P(), P(), P(),
+                          (row,) * nbuf, (row,) * nbuf,
+                          (row,) * nbuf, (row,) * nbuf),
+                out_specs=((row,) * nbuf, (row,) * nbuf, (row,) * nbuf,
+                           (row,) * ncast, P(), P()),
+                axis_names={shard_axis}, check_vma=False)
+            out = fn(base, seed0, pre_coeff_arr, extra_skip_arr,
+                     tuple(gbufs), tuple(pbufs), tuple(ms), tuple(vs))
+        else:
+            out = region(base, seed0, pre_coeff_arr, extra_skip_arr,
+                         tuple(gbufs), tuple(pbufs), tuple(ms),
+                         tuple(vs))
+        new_pb, new_mb, new_vb, new_cb, grad_norm, overflow = out
+
+        new_leaves = list(p_leaves)
+        cast_leaves = list(p_leaves) if cast_dtype is not None else None
+        ci = 0
+        for k, (gi, dt, idxs, sizes, Lpad) in enumerate(plan):
+            for i, a in _unflatten_group(new_pb[k], p_leaves, idxs,
+                                         shards).items():
+                new_leaves[i] = a
+            if cast_leaves is not None:
+                if cast_groups[k]:
+                    src = new_cb[ci]
+                    ci += 1
+                    for i, a in _unflatten_group(src, p_leaves, idxs,
+                                                 shards).items():
+                        cast_leaves[i] = a
+                else:
+                    # Same dtype (or SR bf16 write): the param output IS
+                    # the compute-dtype value — alias, don't copy.
+                    for i, a in _unflatten_group(new_pb[k], p_leaves,
+                                                 idxs, shards).items():
+                        cast_leaves[i] = a
+        if cast_leaves is not None:
+            # Non-float leaves mirror _cast_floats: passed through as-is.
+            cast_params = jax.tree_util.tree_unflatten(
+                treedef, cast_leaves)
+        else:
+            cast_params = None
+        new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
+        if one_pass:
+            count_inc = state.count + \
+                jnp.where(overflow, 0, 1).astype(jnp.int32)
+        else:
+            count_inc = state.count + 1
+        new_state = FusedAdamState(
+            count=count_inc,
+            m=tuple(b.reshape(-1) for b in new_mb),
+            v=tuple(b.reshape(-1) for b in new_vb))
+        return new_params, new_state, cast_params, grad_norm, overflow
+
+    def _apply_per_leaf(grads, state, params, *, pre_coeff=None,
+                       sr_key=None):
+        """Ablation mode: one kernel launch per leaf."""
+        p_leaves, treedef = _leaves(params)
+        g_leaves = treedef.flatten_up_to(grads)
+        base = _base_scalars(state.count, None)
+        seed0 = jax.random.bits(sr_key, (), jnp.uint32).astype(jnp.int32) \
+            if sr_key is not None else jnp.zeros((), jnp.int32)
+        coeff = jnp.asarray(1.0 if pre_coeff is None else pre_coeff,
+                            jnp.float32)
+        scalars = jnp.stack(
+            [base[0], base[1], base[2], coeff, base[3],
+             jnp.float32(0.0), jnp.float32(0.0), jnp.float32(0.0)])[None]
         new_leaves = list(p_leaves)
         new_m, new_v = [], []
-        for gi, (dt, idxs) in enumerate(groups):
+        for gi, (dt, idxs) in enumerate(_float_groups(p_leaves)):
             sr = sr_key is not None and dt == jnp.dtype(jnp.bfloat16)
-            seed = (seed0 + jnp.int32(gi)).reshape(1, 1)
-            run = functools.partial(
-                _run_group, scalars=scalars, seed=seed, b1=b1, b2=b2,
-                eps=eps, wd=weight_decay, coupled=not adam_w_mode,
-                scale_grads=clip_coeff is not None, sr=sr, out_dtype=dt)
-            if multi_tensor:
-                sizes = [int(p_leaves[i].size) for i in idxs]
-                npad = _pad_to_chunk(sum(sizes))
-                # Grads flatten in f32, NOT the param dtype: master-free
-                # engines hand in f32-accumulated grads over bf16 params,
-                # and truncating them here would defeat the kernel's
-                # f32-second-moment guarantee before it ever reads them.
-                pflat, mn, vn = run(
-                    _flatten_group(g_leaves, idxs, jnp.float32, npad),
-                    _flatten_group(p_leaves, idxs, dt, npad),
-                    state.m[gi], state.v[gi])
-                off = 0
-                for i, sz in zip(idxs, sizes):
-                    new_leaves[i] = \
-                        pflat[off:off + sz].reshape(p_leaves[i].shape)
-                    off += sz
-                new_m.append(mn)
-                new_v.append(vn)
-            else:
-                ms, vs = [], []
-                for j, i in enumerate(idxs):
-                    sz = int(p_leaves[i].size)
-                    npad = _pad_to_chunk(sz)
-                    pf, mn, vn = run(
-                        _flatten_group(g_leaves, [i], jnp.float32, npad),
-                        _flatten_group(p_leaves, [i], dt, npad),
-                        state.m[gi][j], state.v[gi][j])
-                    new_leaves[i] = pf[:sz].reshape(p_leaves[i].shape)
-                    ms.append(mn)
-                    vs.append(vn)
-                new_m.append(tuple(ms))
-                new_v.append(tuple(vs))
+            ms, vs = [], []
+            for j, i in enumerate(idxs):
+                n = int(p_leaves[i].size)
+                Lpad = _group_row_len([n], shards)
+                seed = jnp.stack([seed0 + jnp.int32(gi),
+                                  jnp.int32(0)])[None]
+                gf = _flatten_group(g_leaves, [i], jnp.float32, shards,
+                                    Lpad)
+                pf = _flatten_group(p_leaves, [i], dt, shards, Lpad)
+                pn, mn, vn, _ = _run_group(
+                    gf.reshape(-1), pf.reshape(-1), state.m[gi][j],
+                    state.v[gi][j], scalars, seed, b1=b1, b2=b2,
+                    eps=eps, wd=weight_decay, coupled=not adam_w_mode,
+                    use_inv=False, use_coeff=pre_coeff is not None,
+                    one_pass=False, sr=sr, cast=False, out_dtype=dt,
+                    cast_dtype=None)
+                new_leaves[i] = _unflatten_group(
+                    pn.reshape(shards, Lpad), p_leaves, [i], shards)[i]
+                ms.append(mn)
+                vs.append(vn)
+            new_m.append(tuple(ms))
+            new_v.append(tuple(vs))
         new_params = jax.tree_util.tree_unflatten(treedef, new_leaves)
-        return new_params, FusedAdamState(count=count_inc, m=tuple(new_m),
-                                          v=tuple(new_v))
+        return new_params, FusedAdamState(count=state.count + 1,
+                                          m=tuple(new_m),
+                                          v=tuple(new_v)), None, \
+            jnp.asarray(-1.0, jnp.float32), jnp.asarray(False)
+
+    def _apply(grads, state, params, clip_coeff=None, sr_key=None):
+        """PR-1 two-pass API: the caller resolved clip/overflow."""
+        new_params, new_state, _, _, _ = _apply_impl(
+            grads, state, params, pre_coeff=clip_coeff, sr_key=sr_key)
+        return new_params, new_state
+
+    def _step(grads, state, params, *, clip=0.0, inv_scale=None,
+              fp16=False, compute_norm=True, extra_skip=None,
+              sr_key=None, cast_dtype=None) -> FusedStepOut:
+        """One-pass clipped update (module docstring): grads may still
+        carry the fp16 loss scale (``inv_scale`` unscales in-kernel);
+        norm/overflow/clip/skip/cast all ride the single HBM pass."""
+        new_params, new_state, cast_params, grad_norm, overflow = \
+            _apply_impl(grads, state, params, inv_scale=inv_scale,
+                        clip=clip, fp16=fp16, compute_norm=compute_norm,
+                        extra_skip=extra_skip, one_pass=True,
+                        sr_key=sr_key, cast_dtype=cast_dtype)
+        return FusedStepOut(new_params, new_state, cast_params,
+                            grad_norm, overflow)
 
     def update_fn(updates, state, params=None):
         """optax-compatible wrapper: returns delta-style updates so generic
         callers (``optax.apply_updates``) keep working. The engine's train
-        steps call ``fused_apply`` instead for the true single-pass write."""
+        steps call ``fused_step``/``fused_apply`` instead for the true
+        single-pass write."""
         new_params, new_state = _apply(updates, state, params)
         deltas = jax.tree_util.tree_map(
             lambda np_, p: (np_.astype(jnp.float32) -
@@ -332,12 +816,19 @@ def fused_adam(learning_rate: ScheduleOrFloat, b1: float = 0.9,
             new_params, params)
         return deltas, new_state
 
+    # Per-leaf ablation mode has no one-pass story (it ignores the
+    # norm/clip/overflow/cast machinery) — expose fused_step=None so the
+    # engine falls back to the two-pass apply instead of silently
+    # dropping clipping.
     return FusedGradientTransformation(init=init_fn, update=update_fn,
-                                       fused_apply=_apply)
+                                       fused_apply=_apply,
+                                       fused_step=_step if multi_tensor
+                                       else None)
 
 
 class FusedGradientTransformation(NamedTuple):
-    """optax.GradientTransformation duck-type + the fused entry point."""
+    """optax.GradientTransformation duck-type + the fused entry points."""
     init: Callable[[Any], FusedAdamState]
     update: Callable[..., Tuple[Any, FusedAdamState]]
     fused_apply: Callable[..., Tuple[Any, FusedAdamState]]
+    fused_step: Callable[..., FusedStepOut]

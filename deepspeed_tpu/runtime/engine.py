@@ -319,6 +319,13 @@ class DeepSpeedEngine:
                 self.config.optimizer_name or C.ADAM_OPTIMIZER, fallback,
                 self._schedule_fn)
         self._fused_apply = getattr(self.tx, "fused_apply", None)
+        # One-pass clipped update (ops/fused_update.fused_step): the
+        # global-norm reduction, fp16 unscale, overflow vote+skip, clip,
+        # and the compute-dtype cast-cache refresh all ride the single
+        # HBM pass over optimizer state — param/m/v are read exactly
+        # once per step. None => the historical two-pass sequencing
+        # (separate norm read before the fused apply).
+        self._fused_step = getattr(self.tx, "fused_step", None)
 
         # ZeRO-Offload: masters + moments live in host RAM, updated by the
         # C++ SIMD Adam; the device holds ONLY compute-dtype params and
@@ -637,8 +644,26 @@ class DeepSpeedEngine:
             raise TypeError("optimizer must be an optax.GradientTransformation "
                             "or callable(schedule_fn) -> transformation")
         name = self.config.optimizer_name or C.ADAM_OPTIMIZER
+        # ZeRO-shard-local fused apply: on a pure-dp mesh with sharded
+        # optimizer state, the fused kernels run under shard_map over dp
+        # so the moments are never gathered (each device updates exactly
+        # its ZeRO shard). Meshes with live pipe/seq/model axes keep the
+        # plain lowering (partial-auto shard_map is outside this jax's
+        # capability envelope — tests/capability.py).
+        mesh_kw = dict(mesh=self.mesh, shard_axis=DP_AXIS) \
+            if self._fused_shard_local() else {}
         return build_optimizer(name, dict(self.config.optimizer_params or {}),
-                               self._schedule_fn)
+                               self._schedule_fn, **mesh_kw)
+
+    def _fused_shard_local(self) -> bool:
+        """True when the fused optimizer kernels run shard-local over dp
+        (pure-dp mesh, ZeRO state sharded). The ONE predicate both the
+        optimizer construction and the roofline's optimizer_apply
+        pricing use — they must agree or the per-device byte figures
+        lie."""
+        return (self.zero_optimization_stage() >= 1 and self.dp_size > 1
+                and all(int(s) == 1 for a, s in self.mesh.shape.items()
+                        if a != DP_AXIS))
 
     def _loss_scaler_config(self) -> Dict[str, Any]:
         cfg = self.config
@@ -1665,6 +1690,7 @@ class DeepSpeedEngine:
         compute_dtype = self.compute_dtype
         tx = self.tx
         fused_apply = self._fused_apply
+        fused_step = self._fused_step
         scaler_kw = self._scaler_kw
         if float(self.config.gradient_predivide_factor or 1.0) != 1.0:
             # Subsumed by design: grads are accumulated in fp32 as the mean
@@ -1780,42 +1806,64 @@ class DeepSpeedEngine:
                     accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
                     (micro_batches, keys))
 
-            # Unscale the loss-scaled gradients. Non-fp16 runs at a static
-            # scale of 1.0 — skip the full-tree multiply entirely.
-            if fp16:
-                inv = 1.0 / scale
-                grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
-
-            overflow = tree_has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
-
-            if (clip and clip > 0) or fp16:
-                grad_norm = global_norm(grads)
+            sr_key = jax.random.fold_in(rng, 0x5352) if master_free \
+                else None
+            if fused_step is not None:
+                # One-pass clipped update: the norm reduction (which
+                # doubles as the fp16 overflow vote — inf/nan in any grad
+                # surfaces as a non-finite sum of squares), the unscale
+                # multiply, the clip coefficient, the overflow-skip
+                # select, and the compute-dtype cast-cache refresh ALL
+                # ride the fused kernels' single read/write of
+                # grad+param+m+v. No separate global_norm pass, no
+                # full-tree unscale, no post-apply jnp.where select, no
+                # standalone cast pass.
+                out = fused_step(
+                    grads, state.opt_state, state.params, clip=clip,
+                    inv_scale=(1.0 / scale) if fp16 else None, fp16=fp16,
+                    compute_norm=bool(clip and clip > 0) or fp16,
+                    sr_key=sr_key,
+                    cast_dtype=compute_dtype if use_cache else None)
+                new_params, new_opt_state = out.params, out.state
+                new_cast = out.cast_params if use_cache else None
+                grad_norm, overflow = out.grad_norm, out.overflow
             else:
-                # Full-tree norm is an extra HBM pass; only pay for it when
-                # something consumes it (clipping / overflow diagnostics).
-                grad_norm = jnp.asarray(-1.0, jnp.float32)
-            # Single-pass Pallas multi-tensor apply when fused: the
-            # global-clip coefficient rides into the kernel's grad read
-            # and master-free stochastic rounding onto the in-kernel
-            # param write (shared _clipped_update helper).
-            new_params, new_opt_state = _clipped_update(
-                grads, state, grad_norm, tx=tx, fused_apply=fused_apply,
-                clip=clip, master_free=master_free,
-                sr_key=(jax.random.fold_in(rng, 0x5352)
-                        if master_free else None))
-            # Refresh the compute-dtype cache in the same fused pass as the
-            # param update (one extra compute-dtype write instead of next
-            # step's full fp32 re-read + cast).
-            new_cast = _cast_floats(new_params, compute_dtype) \
-                if use_cache else None
+                # Two-pass path (optax chain / per-leaf fused ablation):
+                # unscale the loss-scaled gradients. Non-fp16 runs at a
+                # static scale of 1.0 — skip the full-tree multiply.
+                if fp16:
+                    inv = 1.0 / scale
+                    grads = jax.tree_util.tree_map(lambda g: g * inv, grads)
 
-            # Overflow-skip (reference step semantics engine.py:1000-1085):
-            # keep old params/opt state, don't advance step (so LR holds).
-            keep = overflow
-            new_params = _tree_select(keep, state.params, new_params)
-            new_opt_state = _tree_select(keep, state.opt_state, new_opt_state)
-            if use_cache:
-                new_cast = _tree_select(keep, state.cast_params, new_cast)
+                overflow = tree_has_inf_or_nan(grads) if fp16 \
+                    else jnp.asarray(False)
+
+                if (clip and clip > 0) or fp16:
+                    grad_norm = global_norm(grads)
+                else:
+                    # Full-tree norm is an extra HBM pass; only pay for it
+                    # when something consumes it (clipping / overflow
+                    # diagnostics).
+                    grad_norm = jnp.asarray(-1.0, jnp.float32)
+                new_params, new_opt_state = _clipped_update(
+                    grads, state, grad_norm, tx=tx, fused_apply=fused_apply,
+                    clip=clip, master_free=master_free, sr_key=sr_key)
+                # Refresh the compute-dtype cache in the same fused pass as
+                # the param update (one extra compute-dtype write instead
+                # of next step's full fp32 re-read + cast).
+                new_cast = _cast_floats(new_params, compute_dtype) \
+                    if use_cache else None
+
+                # Overflow-skip (reference step semantics
+                # engine.py:1000-1085): keep old params/opt state, don't
+                # advance step (so LR holds).
+                keep = overflow
+                new_params = _tree_select(keep, state.params, new_params)
+                new_opt_state = _tree_select(keep, state.opt_state,
+                                             new_opt_state)
+                if use_cache:
+                    new_cast = _tree_select(keep, state.cast_params,
+                                            new_cast)
 
             # Shared overflow-vote resolution: step/skip bookkeeping +
             # loss-scale state machine.
@@ -2067,6 +2115,9 @@ class DeepSpeedEngine:
             payload = build_cost_model(
                 tl.sentinel, comm_bytes_by_path=comm,
                 step_paths=step_paths, n_devices=int(self.mesh.size))
+            pricing = self._optimizer_apply_pricing()
+            if pricing is not None:
+                payload["optimizer_apply"] = pricing
             payload.update(self._cost_model_extras(payload))
             tl.set_cost_model(payload,
                               samples_per_step=self.train_batch_size())
@@ -2082,6 +2133,41 @@ class DeepSpeedEngine:
         except Exception as e:   # observability must not kill training
             tl.event("cost_model_error",
                      {"error": f"{type(e).__name__}: {e}"[:300]})
+
+    def _optimizer_apply_pricing(self) -> Optional[Dict[str, Any]]:
+        """Analytic HBM bytes the optimizer APPLY phase moves per step
+        (ops/fused_update.apply_hbm_bytes): the active mode priced
+        against the alternative, so the roofline record carries the
+        one-pass-vs-two-pass ratio explicitly.  Figures are per replica
+        of the full tree; under ZeRO the apply runs shard-local, so
+        per-DEVICE bytes divide by ``zero_shard_divisor`` uniformly.
+        None for engines whose apply is not the fused family (offload's
+        host Adam, onebit's compressed exchange price differently)."""
+        if self._fused_apply is None or self._offload is not None \
+                or self._onebit:
+            return None
+        from ..ops.fused_update import apply_hbm_bytes
+        # Sparse-gradient engines route the apply through the two-pass
+        # sparse_apply_step regardless of fused_step availability.
+        one_pass = self._fused_step is not None and \
+            self._sparse_mask is None
+        pricing = apply_hbm_bytes(
+            self.state.params, one_pass=one_pass,
+            cast_dtype=(self.compute_dtype if self._use_cast_cache
+                        else None),
+            fp16=self.config.fp16_enabled,
+            clip=bool(self.gradient_clipping()))
+        # Per-device bytes divide by dp only where the kernels actually
+        # run shard-local — the same predicate that handed the mesh to
+        # fused_adam (a live mp/pp axis keeps the plain lowering on
+        # full buffers).
+        shard = self.dp_size if self._fused_shard_local() else 1
+        return {
+            "mode": "one_pass" if one_pass else "two_pass",
+            "per_replica": pricing,
+            "zero_shard_divisor": shard,
+            "active_bytes_per_device": int(pricing["active"] // shard),
+        }
 
     def _cost_model_step_paths(self) -> Dict[str, float]:
         """{path_name: invocations per optimizer step} for the paths that
@@ -2362,24 +2448,42 @@ class DeepSpeedEngine:
             if grad_sh is not None else jax.jit(grad_step)
 
         fused_apply = self._fused_apply
+        fused_step = self._fused_step
+        use_cache = self._use_cast_cache
 
         def apply_grads(state: EngineState, grads):
             scale = state.loss_scale
-            grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
-            overflow = tree_has_inf_or_nan(grads) if fp16 else jnp.asarray(False)
-            grad_norm = global_norm(grads)
-            new_params, new_opt = _clipped_update(
-                grads, state, grad_norm, tx=tx, fused_apply=fused_apply,
-                clip=clip)
-            # Same cache refresh as the fused train step: the next
-            # train_batch reads state.cast_params.
-            new_cast = None
-            if state.cast_params is not None:
-                new_cast = _tree_select(
-                    overflow, state.cast_params,
-                    _cast_floats(new_params, compute_dtype))
-            new_params = _tree_select(overflow, state.params, new_params)
-            new_opt = _tree_select(overflow, state.opt_state, new_opt)
+            if fused_step is not None:
+                # One-pass clipped update, same contract as the main
+                # train step: unscale (scale is a traced 1.0 when not
+                # fp16 — the kernel's scalar multiply replaces the
+                # historical full-tree g/scale pass either way), norm,
+                # overflow vote, clip, skip-select and cast-cache
+                # refresh inside the single optimizer-state HBM pass.
+                out = fused_step(
+                    grads, state.opt_state, state.params, clip=clip,
+                    inv_scale=1.0 / scale, fp16=fp16, compute_norm=True,
+                    cast_dtype=compute_dtype if use_cache else None)
+                new_params, new_opt = out.params, out.state
+                new_cast = out.cast_params if use_cache else None
+                grad_norm, overflow = out.grad_norm, out.overflow
+            else:
+                grads = jax.tree_util.tree_map(lambda g: g / scale, grads)
+                overflow = tree_has_inf_or_nan(grads) if fp16 \
+                    else jnp.asarray(False)
+                grad_norm = global_norm(grads)
+                new_params, new_opt = _clipped_update(
+                    grads, state, grad_norm, tx=tx, fused_apply=fused_apply,
+                    clip=clip)
+                # Same cache refresh as the fused train step: the next
+                # train_batch reads state.cast_params.
+                new_cast = None
+                if state.cast_params is not None:
+                    new_cast = _tree_select(
+                        overflow, state.cast_params,
+                        _cast_floats(new_params, compute_dtype))
+                new_params = _tree_select(overflow, state.params, new_params)
+                new_opt = _tree_select(overflow, state.opt_state, new_opt)
             new_state = state.replace(
                 params=new_params, opt_state=new_opt, cast_params=new_cast,
                 **_overflow_resolution(state, overflow, **scaler_kw))
@@ -2452,6 +2556,14 @@ class DeepSpeedEngine:
             "ds_config_precision": self.config.precision_dtype,
             "client_state": client_state or {},
         }
+        if type(getattr(self.state, "opt_state", None)).__name__ == \
+                "FusedAdamState":
+            # Moment-buffer layout version: 2 = V-interleaved shard-local
+            # rows (ISSUE 8). Pre-v2 checkpoints stored end-to-end leaf
+            # concatenation — same flat dtype, sometimes the same padded
+            # SIZE, so a silent restore would scramble moments across
+            # leaves; the load path refuses them instead.
+            meta["fused_moment_layout"] = 2
         if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
             meta["lr_scheduler"] = self.lr_scheduler.state_dict()
 
@@ -2581,6 +2693,23 @@ class DeepSpeedEngine:
                 meta = json.load(f)
 
         host_state = jax.device_get(self.state.replace(cast_params=None))
+        if load_optimizer_states and \
+                type(host_state.opt_state).__name__ == "FusedAdamState" \
+                and int(meta.get("fused_moment_layout", 1)) != 2:
+            # The fused moment buffers changed layout (end-to-end leaf
+            # concatenation -> V-interleaved rows, ISSUE 8). The flat
+            # sizes can coincide, so a structural restore would SILENTLY
+            # scramble Adam moments across leaves — refuse loudly,
+            # BEFORE any engine state (params, counters) is touched so a
+            # caller catching the error keeps a consistent engine.
+            raise ValueError(
+                f"checkpoint {path} stores fused optimizer moments in the "
+                "pre-ISSUE-8 flat layout (no fused_moment_layout=2 marker "
+                "in engine_meta.json) which is incompatible with the "
+                "V-interleaved buffers this engine runs; load with "
+                "load_optimizer_states=False (params restore fine, "
+                "moments re-initialize) or re-save from the writing "
+                "version")
         params_target = host_state.params if self._offload is None \
             else jax.device_get(self._offload.master_tree())
         if meta.get("pipeline_layer_files"):

@@ -392,7 +392,8 @@ class TestBenchGate:
 
     def test_extract_metrics_all_shapes(self):
         bg = load_bench_gate()
-        none_srv = {"serve_tps": None, "ttft_p95": None}
+        none_srv = {"serve_tps": None, "ttft_p95": None,
+                    "kernel_speedup": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}
@@ -466,3 +467,146 @@ class TestBenchGate:
         assert [os.path.basename(p) for p in pair] == \
             ["BENCH_r02.json", "BENCH_r10.json"]   # numeric, no _builder
         assert bg.main(["--dir", str(tmp_path)]) == 0   # nothing comparable
+
+
+# --------------------------------------------------------------------- #
+# Optimizer-apply analytic pricing (one-pass vs two-pass HBM bytes)
+# --------------------------------------------------------------------- #
+class TestOptimizerApplyPricing:
+    def test_fp16_two_pass_is_over_double(self):
+        """The ISSUE-8 acceptance arithmetic, HONEST accounting: under
+        fp16 the historical two-pass sequencing really paid the unscale
+        read+write, the tree_has_inf_or_nan re-read, AND a traced
+        overflow select over old+new p/m/v — >2x the one-pass bytes.
+        (For non-fp16 the select was a folded constant; no saving is
+        claimed there.)"""
+        from deepspeed_tpu.ops.fused_update import apply_hbm_bytes
+        params = {"w": jnp.zeros((1000, 1000), jnp.float32),
+                  "b": jnp.zeros((1000,), jnp.float32)}
+        pricing = apply_hbm_bytes(params, one_pass=True, fp16=True,
+                                  cast_dtype=jnp.bfloat16, clip=True)
+        assert pricing["active"] == pricing["one_pass"]
+        assert pricing["ratio_two_over_one"] >= 2.0, pricing
+        n = 1000 * 1000 + 1000
+        # one-pass: apply kernel (g4 + p4 + mv8 read, p4 + mv8 write,
+        # cast2 write) + the sqnorm re-read of g (the norm is NOT free
+        # in one-pass mode — it is a wash with two-pass's norm read).
+        assert pricing["one_pass"] == n * (4 + 12 + 12 + 2 + 4)
+
+    def test_norm_wash_and_foldable_select_claim_nothing(self):
+        """clip toggles the norm read on BOTH sides (a wash); non-fp16
+        overflow select is priced at zero (XLA folds it); master-free
+        bf16 without clip is byte-NEUTRAL between the modes."""
+        from deepspeed_tpu.ops.fused_update import apply_hbm_bytes
+        params = {"w": jnp.zeros((512, 512), jnp.bfloat16)}
+        n = 512 * 512
+        off = apply_hbm_bytes(params, clip=False)
+        on = apply_hbm_bytes(params, clip=True)
+        assert on["one_pass"] - off["one_pass"] == 4 * n
+        assert on["two_pass"] - off["two_pass"] == 4 * n
+        # the r05 bench shape: no clip, no fp16, no cast — modes equal
+        assert off["ratio_two_over_one"] == 1.0, off
+
+    def test_cast_pass_prices_only_the_reread(self):
+        from deepspeed_tpu.ops.fused_update import apply_hbm_bytes
+        params = {"w": jnp.zeros((512, 512), jnp.float32)}
+        n = 512 * 512
+        base = apply_hbm_bytes(params, clip=True)
+        cast = apply_hbm_bytes(params, clip=True, cast_dtype=jnp.bfloat16)
+        # cast write (2B) exists in BOTH modes; two-pass adds only the
+        # updated-param re-read (4B) of the standalone cast pass.
+        assert cast["one_pass"] - base["one_pass"] == 2 * n
+        assert cast["two_pass"] - base["two_pass"] == (2 + 4) * n
+
+    def test_engine_payload_carries_one_pass_mode(self, tmp_path):
+        """The dp=8 ZeRO-2 fused engine's cost model payload reports the
+        apply path at one-pass pricing with the ~2x alternative ratio —
+        the roofline acceptance record for the halved optimizer bytes."""
+        from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+        from deepspeed_tpu.parallel.topology import build_mesh
+
+        def loss_fn(params, batch, rng):
+            x, y = batch
+            return jnp.mean((x @ params["w"] - y) ** 2)
+
+        params = {"w": jnp.zeros((32, 8), jnp.float32)}
+        eng = DeepSpeedEngine(
+            model=loss_fn, model_params=params,
+            config={
+                "train_batch_size": 16,
+                "train_micro_batch_size_per_gpu": 2,
+                "gradient_clipping": 1.0,
+                "optimizer": {"type": "AdamW",
+                              "params": {"lr": 1e-3, "fused": True}},
+                "zero_optimization": {"stage": 2},
+                "bf16": {"enabled": True},
+                "steps_per_print": 10 ** 9,
+                "telemetry": {"enabled": True,
+                              "output_path": str(tmp_path),
+                              "job_name": "oap",
+                              "report_steps": 10 ** 9},
+            }, mesh=build_mesh())
+        r = np.random.default_rng(0)
+        batch = (jnp.asarray(r.standard_normal((16, 32)), jnp.float32),
+                 jnp.asarray(r.standard_normal((16, 8)), jnp.float32))
+        eng.train_batch(batch)
+        eng._maybe_build_cost_model()
+        payload = eng.telemetry.cost_model_payload
+        assert payload is not None
+        oap = payload.get("optimizer_apply")
+        assert oap is not None and oap["mode"] == "one_pass"
+        # bf16 + fp32 masters + clip: the honest delta is the standalone
+        # cast pass's param re-read — a modest >1.0 ratio (the ~2.5x
+        # class is fp16-only; master-free bf16 is 1.0).
+        assert oap["per_replica"]["ratio_two_over_one"] > 1.05
+        assert oap["per_replica"]["active"] == \
+            oap["per_replica"]["one_pass"]
+        assert oap["zero_shard_divisor"] == 8
+        assert oap["active_bytes_per_device"] * 8 <= \
+            oap["per_replica"]["active"] + 8
+        eng.telemetry.close()
+
+
+class TestBenchGateKernels:
+    def _write(self, tmp_path, name, doc):
+        import json as _json
+        p = tmp_path / name
+        p.write_text(_json.dumps(doc))
+        return str(p)
+
+    def test_kernel_speedup_extracted_and_gated(self, tmp_path):
+        bg = load_bench_gate()
+        assert bg.extract_metrics(
+            {"kernels": {"fused_speedup": 1.2}})["kernel_speedup"] == 1.2
+        assert bg.extract_metrics(
+            {"parsed": {"kernels": {"fused_speedup": 1.1}}}
+        )["kernel_speedup"] == 1.1
+        old = self._write(tmp_path, "old.json",
+                          {"kernels": {"fused_speedup": 1.20}})
+        bad = self._write(tmp_path, "bad.json",
+                          {"kernels": {"fused_speedup": 1.00}})
+        ok = self._write(tmp_path, "ok.json",
+                         {"kernels": {"fused_speedup": 1.15}})
+        assert bg.main([old, bad]) == 1          # -17% rel: regression
+        assert bg.main([old, ok]) == 0           # -4% rel: within floor
+
+    def test_pre_kernel_rounds_skip_never_fail(self, tmp_path):
+        bg = load_bench_gate()
+        old = self._write(tmp_path, "old.json", {"mfu": 0.5})
+        new = self._write(tmp_path, "new.json",
+                          {"mfu": 0.5,
+                           "kernels": {"fused_speedup": 1.03}})
+        assert bg.main([old, new]) == 0
+
+    def test_recorded_r06_gates_against_r05(self):
+        """The in-tree BENCH_r05 -> BENCH_r06 pair must pass the gate
+        (r06 is the honestly-labeled projected kernel round)."""
+        import json as _json
+        bg = load_bench_gate()
+        r5 = os.path.join(REPO, "BENCH_r05.json")
+        r6 = os.path.join(REPO, "BENCH_r06.json")
+        assert os.path.exists(r6), "run ablate_fused_ln.py --record"
+        assert bg.main([r5, r6]) == 0
+        rec = _json.load(open(r6))["parsed"]
+        assert rec.get("projected") is True      # honesty label
+        assert rec["kernels"]["fused_speedup"] > 1.0

@@ -23,7 +23,8 @@ import numpy as np
 import optax
 import pytest
 
-from deepspeed_tpu.ops.fused_update import fused_adam, FusedAdamState
+from deepspeed_tpu.ops.fused_update import (fused_adam, FusedAdamState,
+                                            leaf_moment_views)
 from deepspeed_tpu.runtime.engine import DeepSpeedEngine
 from deepspeed_tpu.parallel.topology import build_mesh
 
@@ -51,11 +52,18 @@ def _sched(c):
     return jnp.asarray(1e-3, jnp.float32)
 
 
-def _flat_moments(tree):
-    """optax moment tree -> flat f32 vector in the fused buffer's leaf
-    order (tree_flatten order; all-f32 params = one group)."""
-    return np.concatenate([np.asarray(l, np.float32).reshape(-1)
-                           for l in jax.tree_util.tree_leaves(tree)])
+def _assert_moments_bitexact(ref_state, fs, params, step=0):
+    """optax mu/nu vs the fused V-interleaved buffers, per leaf via
+    leaf_moment_views (the buffer layout interleaves every leaf over
+    virtual-shard rows, so raw prefix slices are meaningless)."""
+    mv, vv = leaf_moment_views(fs, params)
+    for k in params:
+        np.testing.assert_array_equal(
+            np.asarray(ref_state.mu[k]), np.asarray(mv[k]),
+            err_msg=f"first moment diverged at step {step} leaf {k}")
+        np.testing.assert_array_equal(
+            np.asarray(ref_state.nu[k]), np.asarray(vv[k]),
+            err_msg=f"second moment diverged at step {step} leaf {k}")
 
 
 class TestTransformParity:
@@ -67,24 +75,20 @@ class TestTransformParity:
         p_ref = p_fus = params
         upd_ref = jax.jit(ref.update)
         upd_fus = jax.jit(fus.fused_apply)
+        n = sum(int(l.size) for l in jax.tree_util.tree_leaves(params))
         for i in range(4):
             g = _grads(i, params)
             u, rs = upd_ref(g, rs, p_ref)
             p_ref = optax.apply_updates(p_ref, u)
             p_fus, fs = upd_fus(g, fs, p_fus)
-            n = _flat_moments(rs[0].mu).size
-            np.testing.assert_array_equal(
-                _flat_moments(rs[0].mu), np.asarray(fs.m[0][:n]),
-                err_msg=f"first moment diverged at step {i}")
-            np.testing.assert_array_equal(
-                _flat_moments(rs[0].nu), np.asarray(fs.v[0][:n]),
-                err_msg=f"second moment diverged at step {i}")
+            _assert_moments_bitexact(rs[0], fs, params, step=i)
             for k in params:
                 np.testing.assert_allclose(
                     np.asarray(p_ref[k]), np.asarray(p_fus[k]),
                     rtol=1e-6, atol=1e-7, err_msg=f"step {i} leaf {k}")
-        # the pad region of the fused buffers stays exactly zero
-        assert not np.any(np.asarray(fs.m[0][n:]))
+        # the pad regions of the fused buffers stay exactly zero: the
+        # buffer can hold at most n nonzero (real-element) entries
+        assert np.count_nonzero(np.asarray(fs.m[0])) <= n
 
     def test_coupled_adam_parity(self):
         """adam_w_mode=False folds decay into the grad BEFORE the moments
@@ -160,9 +164,10 @@ class TestTransformParity:
         g = {"w": jnp.full((64,), g_val, jnp.float32)}
         fus = fused_adam(_sched, B1, B2, EPS, 0.0)
         _, fs = jax.jit(fus.fused_apply)(g, fus.init(params), params)
-        np.testing.assert_allclose(np.asarray(fs.m[0][:64]),
+        mv, vv = leaf_moment_views(fs, params)
+        np.testing.assert_allclose(np.asarray(mv["w"]),
                                    np.float32((1 - B1) * g_val), rtol=1e-6)
-        np.testing.assert_allclose(np.asarray(fs.v[0][:64]),
+        np.testing.assert_allclose(np.asarray(vv["w"]),
                                    np.float32((1 - B2) * g_val ** 2),
                                    rtol=1e-5)
 
@@ -197,6 +202,122 @@ class TestTransformParity:
                 np.asarray(p_sr2[k], np.float32))
         assert any_diff, "distinct seeds must round differently somewhere"
         assert fs_sr.m[0].dtype == jnp.float32
+
+
+class TestOnePassStep:
+    """fused_step: norm + clip + overflow + cast all inside the single
+    HBM pass, vs the historical two-pass sequencing."""
+
+    def test_matches_two_pass_clip(self):
+        """fused_step(clip=c) == global_norm + clip_coefficient +
+        fused_apply(clip_coeff=...) — the two paths share the clip
+        expression textually, so parity is tight."""
+        from deepspeed_tpu.runtime.utils import clip_coefficient, global_norm
+        params = _tree(8)
+        clip = 0.5
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        out = jax.jit(lambda g, s, p: fus.fused_step(g, s, p, clip=clip))(
+            g, fs, params)
+        norm = global_norm(g)
+        coeff = clip_coefficient(norm, clip)
+        p_two, fs_two = jax.jit(lambda g, s, p, c: fus.fused_apply(
+            g, s, p, clip_coeff=c))(g, fs, params, coeff)
+        np.testing.assert_allclose(float(out.grad_norm), float(norm),
+                                   rtol=1e-6)
+        assert not bool(out.overflow)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(out.params[k]),
+                                       np.asarray(p_two[k]),
+                                       rtol=1e-6, atol=1e-7)
+        # moments track g*coeff; the one-pass norm sums chunk partials in
+        # a different association than per-leaf global_norm, so coeff (and
+        # hence m) agrees to f32 ulp, not bitwise (PR-1 precedent).
+        np.testing.assert_allclose(np.asarray(out.state.m[0]),
+                                   np.asarray(fs_two.m[0]),
+                                   rtol=1e-6, atol=1e-9)
+        assert int(out.state.count) == 1
+
+    def test_fp16_overflow_holds_step_in_kernel(self):
+        """An inf gradient under fp16: the in-pass vote (non-finite sum
+        of squares) holds params/moments bit-identically and the count
+        does not advance — no separate tree_has_inf_or_nan read."""
+        params = _tree(9)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        g = dict(g, b=jnp.asarray(np.inf, jnp.float32))
+        out = jax.jit(lambda g, s, p: fus.fused_step(
+            g, s, p, clip=1.0, inv_scale=jnp.float32(1 / 128.0),
+            fp16=True))(g, fs, params)
+        assert bool(out.overflow)
+        assert int(out.state.count) == 0
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(out.params[k]),
+                                          np.asarray(params[k]))
+        np.testing.assert_array_equal(np.asarray(out.state.m[0]),
+                                      np.asarray(fs.m[0]))
+
+    def test_fp16_unscale_in_kernel(self):
+        """fused_step(inv_scale=1/s) on scale-multiplied grads equals
+        fused_step on the unscaled grads (norm included: ||g*s||/s)."""
+        params = _tree(10)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        s = 1024.0
+        g_scaled = jax.tree_util.tree_map(lambda x: x * s, g)
+        a = jax.jit(lambda g, st, p: fus.fused_step(
+            g, st, p, clip=1.0, inv_scale=jnp.float32(1.0 / s),
+            fp16=True))(g_scaled, fs, params)
+        b = jax.jit(lambda g, st, p: fus.fused_step(g, st, p, clip=1.0))(
+            g, fs, params)
+        np.testing.assert_allclose(float(a.grad_norm), float(b.grad_norm),
+                                   rtol=1e-6)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(a.params[k]),
+                                       np.asarray(b.params[k]),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_cast_refresh_in_pass(self):
+        """cast_dtype=bf16: the compute-dtype copy comes out of the same
+        kernel write and equals an explicit post-apply cast; non-float
+        leaves pass through untouched."""
+        params = dict(_tree(11), idx=jnp.arange(3, dtype=jnp.int32))
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = dict(_grads(0, {k: v for k, v in params.items() if k != "idx"}),
+                 idx=jnp.zeros((3,), jnp.int32))
+        out = jax.jit(lambda g, s, p: fus.fused_step(
+            g, s, p, clip=1.0, cast_dtype=jnp.bfloat16))(g, fs, params)
+        assert out.cast_params is not None
+        for k in ("w", "big", "b"):
+            assert out.cast_params[k].dtype == jnp.bfloat16
+            np.testing.assert_array_equal(
+                np.asarray(out.cast_params[k], np.float32),
+                np.asarray(out.params[k].astype(jnp.bfloat16), np.float32))
+        np.testing.assert_array_equal(np.asarray(out.cast_params["idx"]),
+                                      np.asarray(params["idx"]))
+
+    def test_no_norm_requested(self):
+        """clip=0, fp16 off, compute_norm off: grad_norm reports -1 (the
+        no-extra-HBM-pass sentinel) and the update is the plain apply."""
+        params = _tree(12)
+        fus = fused_adam(_sched, B1, B2, EPS, WD)
+        fs = fus.init(params)
+        g = _grads(0, params)
+        out = jax.jit(lambda g, s, p: fus.fused_step(
+            g, s, p, compute_norm=False))(g, fs, params)
+        assert float(out.grad_norm) == -1.0
+        p_ref, _ = jax.jit(fus.fused_apply)(g, fs, params)
+        for k in params:
+            np.testing.assert_array_equal(np.asarray(out.params[k]),
+                                          np.asarray(p_ref[k]))
+
+    def test_per_leaf_mode_has_no_one_pass(self):
+        fus = fused_adam(_sched, B1, B2, EPS, WD, multi_tensor=False)
+        assert fus.fused_step is None
 
 
 # ------------------------------------------------------------------ #
@@ -297,6 +418,30 @@ def test_engine_parity_master_free_sr():
         rtol=0.05, atol=0.05)
     # and the run learns (the SR mode's whole point)
     assert l_f[-1] < 0.5 * l_f[0]
+
+
+def test_pre_interleave_checkpoint_refused(tmp_path):
+    """A fused-optimizer checkpoint WITHOUT the fused_moment_layout=2
+    marker (pre-ISSUE-8: end-to-end leaf concatenation) must be refused
+    loudly — the flat sizes can coincide and a structural restore would
+    silently scramble moments across leaves."""
+    import json as _json
+    import os as _os
+    eng, _ = _run(_cfg(True), steps=1)
+    eng.save_checkpoint(str(tmp_path), tag="t")
+    mf = _os.path.join(str(tmp_path), "t", "engine_meta.json")
+    with open(mf) as f:
+        meta = _json.load(f)
+    assert meta["fused_moment_layout"] == 2
+    del meta["fused_moment_layout"]
+    with open(mf, "w") as f:
+        _json.dump(meta, f)
+    eng2, _ = _run(_cfg(True), steps=1)
+    with pytest.raises(ValueError, match="fused_moment_layout"):
+        eng2.load_checkpoint(str(tmp_path), tag="t")
+    # params-only restore stays available
+    eng2.load_checkpoint(str(tmp_path), tag="t",
+                         load_optimizer_states=False)
 
 
 def test_engine_fused_checkpoint_roundtrip(tmp_path):

@@ -374,6 +374,12 @@ class TestOnebitWire:
         for name in ("zero1", "zero2", "onebit", "pipeline_1f1b",
                      "ring_attention"):
             assert rec["configs"][name]["pass"] is True, name
+        # ISSUE-8 satellite: the fused-chunk-gather finding is RESOLVED
+        # (shard-local V-interleaved layout) — the recorded artifact must
+        # show zero chunk-sized collectives on the fused apply.
+        chunk = rec["findings"]["fused_chunk_gather"]
+        assert chunk["resolved"] is True
+        assert chunk["fused_chunk_gather_collectives"] == []
 
 
 # ------------------------------------------------------------------ #

@@ -25,7 +25,10 @@ more than ``--goodput-drop`` ABSOLUTE (default 5 points); serving
 tokens/s regresses on a relative drop beyond ``--serve-drop`` (default
 10%) and TTFT p95 on a relative RISE beyond ``--ttft-rise`` (default
 25% — latency percentiles on a CPU mesh are noisy; the gate catches
-step changes, not jitter). A metric missing on either side is skipped
+step changes, not jitter); the fused-kernel ablation speedup (the
+``kernels.fused_speedup`` field a DS_BENCH_KERNELS=1 bench or
+``ablate_fused_ln.py`` records) regresses on a relative drop beyond
+``--kernel-drop`` (default 10%). A metric missing on either side is skipped
 with a notice, never a failure — rounds recorded before this tool (or
 before the serving tier) existed have no such field, and the gate must
 not retroactively break them. Exit 0 = pass/skip, 1 = regression, 2 =
@@ -50,8 +53,8 @@ def _load(path: str) -> Dict[str, Any]:
 
 
 def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
-    """{"mfu", "goodput", "serve_tps", "ttft_p95"} (None when the file
-    doesn't carry one)."""
+    """{"mfu", "goodput", "serve_tps", "ttft_p95", "kernel_speedup"}
+    (None when the file doesn't carry one)."""
     # Driver round file: the bench record rides in "parsed".
     if isinstance(doc.get("parsed"), dict):
         doc = doc["parsed"]
@@ -59,6 +62,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     goodput: Optional[float] = None
     serve_tps: Optional[float] = None
     ttft_p95: Optional[float] = None
+    kernel_speedup: Optional[float] = None
+    # DS_BENCH_KERNELS ablation record: the fused-over-unfused step
+    # speedup (bench.py bench_kernels_ablation / ablate_fused_ln.py).
+    krn = doc.get("kernels")
+    if isinstance(krn, dict) and krn.get("fused_speedup") is not None:
+        kernel_speedup = float(krn["fused_speedup"])
     # TELEMETRY.json shape: structured mfu/goodput sections.
     if isinstance(doc.get("mfu"), dict):
         sec = doc["mfu"]
@@ -80,7 +89,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         if isinstance(ttft, dict) and ttft.get("p95") is not None:
             ttft_p95 = float(ttft["p95"])
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
-            "ttft_p95": ttft_p95}
+            "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -103,7 +112,7 @@ def latest_rounds(directory: str) -> Optional[Tuple[str, str]]:
 
 def gate(old_path: str, new_path: str, mfu_drop: float,
          goodput_drop: float, serve_drop: float = 0.10,
-         ttft_rise: float = 0.25) -> int:
+         ttft_rise: float = 0.25, kernel_drop: float = 0.10) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -171,6 +180,24 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"serving ttft p95: skipped (no serving section in "
               f"{', '.join(missing)})")
 
+    if old["kernel_speedup"] is not None and \
+            new["kernel_speedup"] is not None:
+        compared += 1
+        floor = old["kernel_speedup"] * (1.0 - kernel_drop)
+        verdict = "OK" if new["kernel_speedup"] >= floor else "REGRESSION"
+        print(f"kernel fused speedup: {name_old}="
+              f"{old['kernel_speedup']:.4g}x -> "
+              f"{name_new}={new['kernel_speedup']:.4g}x "
+              f"(floor {floor:.4g}x, -{kernel_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-kernel-ablation rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["kernel_speedup"] is None]
+        print(f"kernel fused speedup: skipped (no kernels record in "
+              f"{', '.join(missing)})")
+
     if compared == 0:
         print("bench_gate: nothing comparable between the two files "
               "(pre-MFU / pre-serving rounds?) — passing")
@@ -194,6 +221,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ttft-rise", type=float, default=0.25,
                     help="max tolerated RELATIVE TTFT p95 rise "
                          "(default 0.25)")
+    ap.add_argument("--kernel-drop", type=float, default=0.10,
+                    help="max tolerated RELATIVE drop of the fused-"
+                         "kernel speedup (default 0.10)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -209,7 +239,7 @@ def main(argv=None) -> int:
         return 2
     try:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
-                    args.serve_drop, args.ttft_rise)
+                    args.serve_drop, args.ttft_rise, args.kernel_drop)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -322,11 +322,14 @@ def audit_ring_attention():
 
 
 def audit_fused_chunk_finding():
-    """Audited finding, not a flagship: the fused optimizer's chunked
-    multi-tensor front end concatenates dp-sharded leaves into flat chunk
-    buffers, which GSPMD assembles by gathering the FULL chunk onto every
-    device each step — visible as chunk-sized collectives the per-leaf
-    optax apply does not emit."""
+    """Regression guard for a RESOLVED finding: the fused optimizer's
+    chunked multi-tensor front end used to concatenate dp-sharded leaves
+    end-to-end, which GSPMD assembled by gathering the FULL padded chunk
+    onto every device each step.  The V-interleaved shard-local layout
+    (ops/fused_update module docstring) keeps every flat buffer
+    dp-sharded through the shard_map'd kernels, so NO chunk-sized
+    collective may appear — an empty list here is the pass condition,
+    and ds_lint's materialization pass gates the same invariant in CI."""
     e = _engine({"zero_optimization": {"stage": 2}},
                 optimizer={"type": "Adam",
                            "params": {"lr": 1e-2, "fused": True}})
@@ -337,10 +340,11 @@ def audit_fused_chunk_finding():
             {"kind": o.kind, "shapes": o.out_shapes,
              "payload_bytes": o.payload_bytes, "op_name": o.op_name}
             for o in big],
-        "note": "optimizer.params.fused under ZeRO sharding assembles "
-                "each flat chunk at full size per device (padded to the "
-                "chunk quantum) — an apply-time transient the audit "
-                "surfaces; grad sync itself is unaffected",
+        "resolved": not big,
+        "note": "RESOLVED by the V-interleaved shard-local chunk layout "
+                "(ISSUE 8): the fused apply's flat buffers stay "
+                "dp-sharded through the shard_map'd kernels; any "
+                "collective listed here is a regression",
     }
 
 
@@ -372,7 +376,8 @@ def main():
                 "error": f"{type(e).__name__}: {str(e)[:300]}", "pass": False}
     record["findings"] = {"fused_chunk_gather": audit_fused_chunk_finding()}
     record["all_pass"] = all(c.get("pass", False)
-                             for c in record["configs"].values())
+                             for c in record["configs"].values()) and \
+        record["findings"]["fused_chunk_gather"].get("resolved", False)
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)
     print(json.dumps({k: v.get("pass") for k, v in

@@ -177,6 +177,11 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "flops_per_step": cm_step.get("flops_per_step"),
             "missing_paths": cm_step.get("missing_paths"),
         })
+        # Optimizer-apply analytic pricing (one-pass vs two-pass HBM
+        # bytes) rides the cost_model record when the engine runs the
+        # fused apply family.
+        if isinstance(cost_model.get("optimizer_apply"), dict):
+            roofline["optimizer_apply"] = cost_model["optimizer_apply"]
         floor = cm_step.get("floor_ms")
         p50 = _percentile(walls, 50)
         if floor and p50 > 0:
