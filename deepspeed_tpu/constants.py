@@ -207,6 +207,60 @@ TELEMETRY_PROFILE_DIR_DEFAULT = ""
 # host-side compile at the boundary; no device traffic, no fences).
 TELEMETRY_COST_MODEL = "cost_model"
 TELEMETRY_COST_MODEL_DEFAULT = True
+# Multi-host: rank 0 writes the primary JSONL; with per_host_shards every
+# other SPMD process writes its own ``<job>.rankK.jsonl`` shard (and
+# ``<trace>.rankK.json`` when tracing) instead of silently discarding its
+# ring records. tools/telemetry_report.py aggregates the shards:
+# per-host step-wall skew (straggler detection) and step-count/loss-hash
+# desync checks.
+TELEMETRY_PER_HOST = "per_host_shards"
+TELEMETRY_PER_HOST_DEFAULT = False
+
+# --- telemetry.health: anomaly detection, hang watchdog, flight recorder
+# The forensic layer (monitor/health.py + monitor/flight.py). All
+# detection is drain-time host work on already-fetched scalars; the only
+# in-graph piece is the per-leaf grad tap below.
+TELEMETRY_HEALTH = "health"
+TELEMETRY_HEALTH_ENABLED = "enabled"
+TELEMETRY_HEALTH_ENABLED_DEFAULT = True
+# In-graph per-leaf grad sum-of-squares tap ([num_leaves] f32, riding
+# the ring to the batched drain fetch — zero added device syncs, one
+# extra read of the grad tree per step). Gives NaN/Inf provenance: the
+# first non-finite leaf and its layer. Wired on the main train step, the
+# forward/backward trio, and the sparse apply; the offload path's host
+# Adam and onebit's in-shard_map update keep their own overflow
+# machinery (grad_norm still feeds the spike detector there).
+TELEMETRY_HEALTH_GRAD_TAPS = "grad_taps"
+TELEMETRY_HEALTH_GRAD_TAPS_DEFAULT = True
+# EWMA z-score spike detection on loss and grad_norm: flag |z| above the
+# threshold after warmup_steps finite samples.
+TELEMETRY_HEALTH_Z_THRESHOLD = "z_threshold"
+TELEMETRY_HEALTH_Z_THRESHOLD_DEFAULT = 6.0
+TELEMETRY_HEALTH_EWMA_ALPHA = "ewma_alpha"
+TELEMETRY_HEALTH_EWMA_ALPHA_DEFAULT = 0.1
+TELEMETRY_HEALTH_WARMUP_STEPS = "warmup_steps"
+TELEMETRY_HEALTH_WARMUP_STEPS_DEFAULT = 20
+# Hang watchdog (off by default: it is a per-engine daemon thread):
+# fires when no step completes within max(watchdog_min_s,
+# watchdog_factor * p95(recent step walls)) — all-thread stack dump
+# (faulthandler), device memory_stats sample, pending step signature.
+TELEMETRY_HEALTH_WATCHDOG = "watchdog"
+TELEMETRY_HEALTH_WATCHDOG_DEFAULT = False
+TELEMETRY_HEALTH_WATCHDOG_FACTOR = "watchdog_factor"
+TELEMETRY_HEALTH_WATCHDOG_FACTOR_DEFAULT = 10.0
+TELEMETRY_HEALTH_WATCHDOG_MIN_S = "watchdog_min_s"
+TELEMETRY_HEALTH_WATCHDOG_MIN_S_DEFAULT = 120.0
+# Crash flight recorder: SIGTERM/SIGINT/atexit handlers persist the last
+# flight_window drained step records, the unsettled goodput window,
+# anomaly events, and a config/mesh/env snapshot to FLIGHT.json
+# (atomically; flight_path "" = <output_path>/FLIGHT.json, per-host
+# shards get FLIGHT.rankK.json).
+TELEMETRY_HEALTH_FLIGHT = "flight_recorder"
+TELEMETRY_HEALTH_FLIGHT_DEFAULT = True
+TELEMETRY_HEALTH_FLIGHT_PATH = "flight_path"
+TELEMETRY_HEALTH_FLIGHT_PATH_DEFAULT = ""
+TELEMETRY_HEALTH_FLIGHT_WINDOW = "flight_window"
+TELEMETRY_HEALTH_FLIGHT_WINDOW_DEFAULT = 64
 
 #############################################
 # Inference / serving (inference/ subsystem)

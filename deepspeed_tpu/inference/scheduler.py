@@ -167,7 +167,10 @@ class ContinuousBatchingScheduler:
                         free.append(slot)
                         del active[slot]
             elif pending and not queue:
-                # Idle ahead of the next arrival — open-loop wait.
+                # Idle ahead of the next arrival — open-loop wait. The
+                # watchdog heartbeat says "idle, not hung": a sparse
+                # arrival stream must not read as a decode-loop stall.
+                eng.telemetry.heartbeat()
                 gap = pending[0].arrival_s - (time.perf_counter() - t0)
                 if gap > 0:
                     time.sleep(min(gap, self.idle_sleep_s))
@@ -175,6 +178,7 @@ class ContinuousBatchingScheduler:
                 # Queued work but no free slot and nothing decoding:
                 # capacity is held outside this serve (caller-activated
                 # slots). Yield instead of busy-spinning.
+                eng.telemetry.heartbeat()
                 time.sleep(self.idle_sleep_s)
 
         wall = time.perf_counter() - t0

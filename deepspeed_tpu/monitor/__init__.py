@@ -13,8 +13,12 @@ boundaries. See docs/tutorials/telemetry.md.
 """
 from .cost_model import (BOUND_COMPUTE, BOUND_HBM, BOUND_INTERCONNECT,
                          build_cost_model, mfu, roofline)
+from .flight import FlightRecorder
 from .goodput import BUCKETS as GOODPUT_BUCKETS
 from .goodput import GoodputLedger
+from .health import (EwmaDetector, HangWatchdog, HealthMonitor, TapSpec,
+                     leaf_sq_taps)
+from .hostinfo import process_identity, resolve_writer, shard_path
 from .memory import (MemoryWatermark, analytic_state_bytes,
                      device_memory_stats)
 from .peaks import (TPU_PEAK_TFLOPS, ChipPeaks, chip_peak_tflops,
@@ -29,6 +33,9 @@ __all__ = [
     "RecompileSentinel", "RecompileError", "MemoryWatermark",
     "analytic_state_bytes", "device_memory_stats",
     "GoodputLedger", "GOODPUT_BUCKETS", "ServingAggregator",
+    "HealthMonitor", "EwmaDetector", "HangWatchdog", "TapSpec",
+    "leaf_sq_taps", "FlightRecorder",
+    "process_identity", "resolve_writer", "shard_path",
     "build_cost_model", "roofline", "mfu",
     "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT",
     "ChipPeaks", "chip_peaks", "chip_peak_tflops", "TPU_PEAK_TFLOPS",

@@ -76,6 +76,19 @@ class GoodputLedger:
         this so trailing attributed time is never silently dropped."""
         return any(v > 0.0 for v in self._noted.values())
 
+    def peek(self, now: Optional[float] = None) -> Dict[str, Any]:
+        """Non-destructive view of the OPEN window (the flight
+        recorder's "unsettled goodput window"): elapsed wall since the
+        last settlement plus the directly-measured buckets noted so far.
+        Settlement math (residual, consistency) only happens at
+        close_window — this is the raw evidence, not a verdict."""
+        now = self._clock() if now is None else now
+        return {
+            "open_window_s": round(max(0.0, now - self.window_t0), 6),
+            "noted_s": {k: round(v, 6) for k, v in self._noted.items()},
+            "windows_closed": self.windows_closed,
+        }
+
     # ------------------------------------------------------------------ #
     # Window settlement (report-boundary work)
     # ------------------------------------------------------------------ #

@@ -1,0 +1,64 @@
+"""Shared SPMD process-identity resolver for the monitor subsystem.
+
+``JsonlSink`` and ``TraceWriter`` each used to carry a private copy of
+the ``is_writer = jax.process_index() == 0`` guard; any drift between
+them (one honoring an override, the other not) silently forks the
+question "who writes files?". This module is the ONE answer, and the
+per-host telemetry shards (``telemetry.per_host_shards``) build on the
+same resolver: rank 0 writes the primary stream, rank K writes
+``<name>.rankK.<ext>`` when sharding is on, and everyone else writes
+nothing — explicitly, with a logged notice instead of a silent drop.
+
+``DS_PROC_INDEX`` / ``DS_PROC_COUNT`` override the jax-reported identity
+(test/bench hook: exercising the multi-host shard + aggregation path on
+a single-process CPU mesh without a real pod).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+
+def process_identity() -> Tuple[int, int]:
+    """(process_index, process_count) — env override first, then jax,
+    then the single-process fallback (jax not importable / backend
+    dead, e.g. inside a crashing signal handler)."""
+    env_idx = os.environ.get("DS_PROC_INDEX")
+    if env_idx is not None:
+        return int(env_idx), int(os.environ.get("DS_PROC_COUNT",
+                                                int(env_idx) + 1))
+    try:
+        import jax
+        return jax.process_index(), jax.process_count()
+    except Exception:
+        return 0, 1
+
+
+def resolve_writer(is_writer: Optional[bool] = None,
+                   per_host: bool = False,
+                   rank: Optional[int] = None,
+                   world: Optional[int] = None
+                   ) -> Tuple[bool, int, int]:
+    """(writes_a_file, rank, world). An explicit ``is_writer`` wins (the
+    historical injection point tests use); otherwise rank 0 always
+    writes, and other ranks write their own shard iff ``per_host``."""
+    if rank is None:
+        rank, world = process_identity()
+    elif world is None:
+        world = rank + 1
+    if is_writer is None:
+        is_writer = rank == 0 or per_host
+    return bool(is_writer), int(rank), int(world)
+
+
+def shard_path(path: str, rank: int) -> str:
+    """Per-host shard name: ``runs/job.jsonl`` -> ``runs/job.rank3.jsonl``
+    for rank 3; rank 0 keeps the primary path (so single-host runs and
+    every existing consumer see the same file they always did)."""
+    if rank == 0:
+        return path
+    root, ext = os.path.splitext(path)
+    return f"{root}.rank{rank}{ext}"
+
+
+__all__ = ["process_identity", "resolve_writer", "shard_path"]

@@ -24,18 +24,31 @@ The JSONL stream is line records tagged by ``kind``:
 - ``report`` — one per drain: samples/sec window, ``window_mfu``,
   skipped steps, device memory sample, the goodput ledger's settled
   window, dropped-record count.
-- ``event``  — recompile sentinel hits, memory watermarks, user events.
+- ``event``  — recompile sentinel hits, memory watermarks, anomaly and
+  watchdog events (monitor/health.py), user events.
 - ``cost_model`` — once per run (first report boundary): per-path
   roofline verdicts from XLA cost analysis + the jaxpr-walk flops
   profiler + the wire model (see monitor/cost_model.py).
+- ``final``  — the terminal drain marker ``close()`` writes. A run
+  segment that ends WITHOUT one was truncated (crash, kill -9, lost
+  pod) and ``tools/telemetry_report.py`` says so instead of presenting
+  partial-window stats as a complete run.
+
+Multi-host: rank 0 writes the primary stream; with
+``telemetry.per_host_shards`` every other process writes
+``<job>.rankK.jsonl`` (monitor/hostinfo.py is the one writer resolver)
+instead of the historical silent record drop, and the report tool
+aggregates the shards (straggler skew, step-count/loss desync).
 
 ``tools/telemetry_report.py`` summarizes a stream into TELEMETRY.json.
 """
 from __future__ import annotations
 
 import atexit
+import functools
 import json
 import os
+import threading
 import time
 from collections import deque
 from contextlib import contextmanager, nullcontext
@@ -44,12 +57,20 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 from .cost_model import mfu as _mfu_formula
+from .flight import FlightRecorder
 from .goodput import GoodputLedger, extract_step_info
+from .health import HangWatchdog, HealthMonitor
+from .hostinfo import resolve_writer, shard_path
 from .memory import MemoryWatermark, analytic_state_bytes, device_memory_stats
 from .peaks import ChipPeaks
 from .recompile import RecompileSentinel
 from .trace import ProfilerWindow, TraceWriter
 from ..utils.logging import log_dist, logger
+
+# The metrics key the engines' in-graph health tap rides under; popped
+# from the record at drain time (provenance feeds anomaly events, not
+# the per-step JSONL, which keeps its scalar-only shape).
+HEALTH_TAP_KEY = "health_leaf_sq"
 
 
 def _to_py(v: Any) -> Any:
@@ -69,30 +90,37 @@ def _to_py(v: Any) -> Any:
 
 class JsonlSink:
     """Line-JSON event sink with the resource story the old engine
-    ``_Monitor`` lacked: the file opens on PROCESS 0 ONLY (every SPMD
-    process used to append to the same file), ``close()`` is idempotent,
-    and an atexit hook closes stragglers. Tensorboard scalars ride along
-    when the writer is importable."""
+    ``_Monitor`` lacked: process 0 writes the primary stream (every SPMD
+    process used to append to the same file); with ``per_host`` every
+    other process writes its own ``<job>.rankK.jsonl`` shard (the
+    hostinfo resolver — no more silent record drop on non-writers);
+    ``close()`` is idempotent, and an atexit hook closes stragglers.
+    Tensorboard scalars ride along when the writer is importable."""
 
     def __init__(self, output_path: str, job_name: str,
-                 tensorboard: bool = False, is_writer: Optional[bool] = None):
-        if is_writer is None:
-            try:
-                import jax
-                is_writer = jax.process_index() == 0
-            except Exception:
-                is_writer = True
-        self.is_writer = bool(is_writer)
+                 tensorboard: bool = False, is_writer: Optional[bool] = None,
+                 per_host: bool = False, rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        self.is_writer, self.rank, self.world = resolve_writer(
+            is_writer, per_host=per_host, rank=rank, world=world)
         self.closed = False
         self.jsonl = None
         self.writer = None
+        self._lock = threading.Lock()   # watchdog events write off-thread
         out = output_path or "./runs"
-        self.path = os.path.join(out, f"{job_name}.jsonl")
+        self.path = shard_path(os.path.join(out, f"{job_name}.jsonl"),
+                               self.rank if self.is_writer else 0)
         if not self.is_writer:
+            if self.world > 1 and not per_host:
+                # The drop is a policy now, not an accident: say so once.
+                logger.info(
+                    f"telemetry: process {self.rank} discards step records "
+                    f"(set telemetry.per_host_shards for a per-host JSONL "
+                    f"shard)")
             return
         os.makedirs(out, exist_ok=True)
         self.jsonl = open(self.path, "a")
-        if tensorboard:
+        if tensorboard and self.rank == 0:
             try:
                 from torch.utils.tensorboard import SummaryWriter
                 self.writer = SummaryWriter(log_dir=os.path.join(out, job_name))
@@ -103,8 +131,9 @@ class JsonlSink:
     def write(self, rec: Dict[str, Any]) -> None:
         if self.closed or self.jsonl is None:
             return
-        self.jsonl.write(json.dumps(rec) + "\n")
-        self.jsonl.flush()
+        with self._lock:
+            self.jsonl.write(json.dumps(rec) + "\n")
+            self.jsonl.flush()
         if self.writer is not None and rec.get("kind") == "step":
             step = int(rec.get("step", 0))
             for k, v in rec.items():
@@ -147,6 +176,9 @@ class Telemetry:
         self.sink: Optional[JsonlSink] = None
         self.profiler: Optional[ProfilerWindow] = None
         self.ledger: Optional[GoodputLedger] = None
+        self.health: Optional[HealthMonitor] = None
+        self.watchdog: Optional[HangWatchdog] = None
+        self.flight: Optional[FlightRecorder] = None
         self.cost_model_payload: Optional[Dict[str, Any]] = None
         self._mfu_arm: Optional[Dict[str, Any]] = None
         self._compile_wall_seen = 0.0
@@ -162,11 +194,18 @@ class Telemetry:
         self.report_steps = int(cfg.report_steps) or \
             max(1, int(default_report_steps))
         self._ring: deque = deque(maxlen=int(cfg.buffer_size))
+        per_host = bool(getattr(cfg, "per_host_shards", False))
         self.sink = JsonlSink(cfg.output_path, cfg.job_name,
                               tensorboard=getattr(cfg, "tensorboard", False),
-                              is_writer=is_writer)
+                              is_writer=is_writer, per_host=per_host)
+        self.meta.setdefault("process_index", self.sink.rank)
+        self.meta.setdefault("process_count", self.sink.world)
+        # close() writes a terminal `final` record; the report tool uses
+        # this capability flag to call a marker-less segment truncated.
+        self.meta.setdefault("emits_final", True)
         if cfg.trace_path:
-            self.tracer = TraceWriter(cfg.trace_path, is_writer=is_writer)
+            self.tracer = TraceWriter(cfg.trace_path, is_writer=is_writer,
+                                      per_host=per_host)
         # Non-writer SPMD processes keep the sentinel/watermark checks but
         # skip step-record collection entirely: buffering scalars and
         # batch-fetching them at drains only to feed a null sink would be
@@ -176,6 +215,46 @@ class Telemetry:
             warmup_calls=cfg.recompile_warmup_calls,
             fail_on_recompile=cfg.fail_on_recompile,
             on_event=self._on_recompile)
+        # Health layer (monitor/health.py + flight.py): drain-time
+        # anomaly detection, the hang watchdog, the crash flight
+        # recorder. All host-side — the only in-graph piece is the
+        # engines' leaf tap, which rides the ring like any other metric.
+        hc = getattr(cfg, "health", None)
+        if hc is not None and getattr(hc, "enabled", False):
+            self.meta.setdefault("health_enabled", True)
+            self.health = HealthMonitor(
+                z_threshold=hc.z_threshold, ewma_alpha=hc.ewma_alpha,
+                warmup_steps=hc.warmup_steps)
+            if hc.watchdog:
+                self.watchdog = HangWatchdog(
+                    factor=hc.watchdog_factor,
+                    min_timeout_s=hc.watchdog_min_s,
+                    dump_dir=cfg.output_path or "./runs",
+                    on_fire=lambda ev: self.event("watchdog", ev))
+                self.watchdog.start()
+            if hc.flight_recorder and self.sink.is_writer:
+                # An explicit flight_path shards per rank too — with
+                # per_host on, every rank persisting to ONE file would
+                # let the last handler clobber the primary's postmortem.
+                fpath = shard_path(
+                    hc.flight_path or os.path.join(
+                        cfg.output_path or "./runs", "FLIGHT.json"),
+                    self.sink.rank)
+                self.flight = FlightRecorder(
+                    fpath, window=hc.flight_window,
+                    snapshot_fn=self._flight_snapshot)
+                fl = self.flight
+                fl.ledger_peek = lambda: (self.ledger.peek()
+                                          if self.ledger else {})
+                fl.ledger_summary = lambda: (self.ledger.summary()
+                                             if self.ledger else {})
+                fl.ring_steps = lambda: [s for s, _, _, _ in self._ring]
+                fl.health_summary = lambda: (self.health.summary()
+                                             if self.health else {})
+                fl.watchdog_fires = lambda: (self.watchdog.fires
+                                             if self.watchdog else 0)
+                fl.install(close_cb=self.close)
+                self.meta.setdefault("flight_path", fpath)
         if int(cfg.profile_start_step) >= 0:
             out = cfg.profile_dir or os.path.join(
                 cfg.output_path or "./runs", "jax_trace")
@@ -192,12 +271,33 @@ class Telemetry:
         """Buffer one step's record. ``metrics`` values may be (and on the
         jitted paths are) un-fetched jax scalars; they sync only at the
         next drain."""
-        if not self.enabled or not self._collect:
+        if not self.enabled:
+            return
+        if self.watchdog is not None:
+            # Heartbeat BEFORE the collect gate: non-collecting SPMD
+            # processes still want hang detection.
+            w = host_fields.get("wall_ms")
+            self.watchdog.beat(float(w) / 1e3
+                               if isinstance(w, (int, float)) else None)
+        if not self._collect:
             return
         if len(self._ring) == self._ring.maxlen:
             self.dropped_records += 1
         self._ring.append((int(step), time.time(), dict(metrics),
                            host_fields))
+
+    def heartbeat(self) -> None:
+        """Manual watchdog beat for loops that are legitimately idle
+        (the serving scheduler waiting on open-loop arrivals is not a
+        hang)."""
+        if self.watchdog is not None:
+            self.watchdog.beat(None)
+
+    def set_tap_spec(self, spec) -> None:
+        """Arm NaN/Inf provenance: the engine hands over the TapSpec
+        decoding its in-graph ``health_leaf_sq`` metric."""
+        if self.health is not None:
+            self.health.spec = spec
 
     def profiler_tick(self, step: int) -> None:
         if self.profiler is not None:
@@ -240,10 +340,28 @@ class Telemetry:
 
     def instrument_step_fn(self, name: str, fn: Callable) -> Callable:
         """Recompile-sentinel wrapping for a compiled step function;
-        identity when telemetry is disabled."""
+        identity when telemetry is disabled. With the hang watchdog on,
+        each dispatch also records the pending step signature (one
+        attribute store) so a watchdog fire can name what the run was
+        stuck on."""
         if self.sentinel is None:
             return fn
-        return self.sentinel.instrument(name, fn)
+        wrapped = self.sentinel.instrument(name, fn)
+        wd = self.watchdog
+        if wd is None:
+            return wrapped
+        raw = getattr(wrapped, "__wrapped__", wrapped)
+
+        @functools.wraps(wrapped)
+        def with_pending(*args, **kwargs):
+            wd.pending(name)
+            return wrapped(*args, **kwargs)
+
+        # Keep the RAW jitted fn reachable (flops profiler / hlo audit
+        # unwrap via __wrapped__); functools.wraps would point it at the
+        # sentinel wrapper instead.
+        with_pending.__wrapped__ = raw
+        return with_pending
 
     def raise_pending(self) -> None:
         """Surface a deferred fail_on_recompile violation (see
@@ -295,6 +413,8 @@ class Telemetry:
                **payload}
         self.events.append(rec)
         self._write(rec)
+        if self.flight is not None:
+            self.flight.note_event(rec)
         if self.tracer is not None:
             self.tracer.instant(kind, args=payload)
 
@@ -394,6 +514,7 @@ class Telemetry:
                     pending.append(v)
         fetched = iter(jax.device_get(pending)) if pending else iter(())
         step_infos = []
+        anomaly_events: List[Dict[str, Any]] = []
         for step, ts, metrics, host_fields in recs:
             rec: Dict[str, Any] = {"kind": "step", "step": step, "ts": ts}
             for k, v in metrics.items():
@@ -401,6 +522,12 @@ class Telemetry:
                                 else v)
             for k, v in host_fields.items():
                 rec[k] = _to_py(v) if not isinstance(v, dict) else v
+            # The in-graph health tap (already fetched in THE batched
+            # device_get above) feeds provenance, not the JSONL record.
+            leaf_sq = rec.pop(HEALTH_TAP_KEY, None)
+            if self.health is not None:
+                anomaly_events.extend(
+                    self.health.check_step(step, rec, leaf_sq))
             wall_ms = rec.get("wall_ms")
             if isinstance(wall_ms, (int, float)):
                 m = self._step_mfu(float(wall_ms) / 1e3)
@@ -413,6 +540,12 @@ class Telemetry:
                     rec["mfu"] = float(f"{m:.4g}")
             step_infos.append(extract_step_info(rec))
             self._write(rec)
+            if self.flight is not None:
+                self.flight.note_step(rec)
+        # Anomaly events write AFTER the window's step records so the
+        # stream stays chronologically readable; each names its step.
+        for ev in anomaly_events:
+            self.event("anomaly", ev)
         report: Dict[str, Any] = {
             "kind": "report", "step": int(self.step_provider()),
             "ts": time.time(), "records": len(recs),
@@ -453,6 +586,8 @@ class Telemetry:
                     "look exactly like this")
                 self.event("memory_watermark", wm_event)
         self._write(report)
+        if self.flight is not None:
+            self.flight.note_report(report)
         if self.tracer is not None:
             self.tracer.flush()
 
@@ -466,10 +601,35 @@ class Telemetry:
         if self.sink is not None:
             self.sink.write(rec)
 
+    def _flight_snapshot(self) -> Dict[str, Any]:
+        """Config/mesh/env snapshot for FLIGHT.json (host metadata only
+        — callable from a signal handler)."""
+        import platform
+        import sys as _sys
+        env: Dict[str, Any] = {"python": platform.python_version(),
+                               "argv": list(_sys.argv)[:8],
+                               "hostname": platform.node()}
+        try:
+            import jax
+            env["jax"] = jax.__version__
+            env["backend"] = jax.default_backend()
+            env["local_devices"] = jax.local_device_count()
+        except Exception:
+            pass
+        return {**{k: v for k, v in self.meta.items()
+                   if not isinstance(v, (list, tuple)) or len(v) < 32},
+                "env": env}
+
     # ------------------------------------------------------------------ #
     def close(self) -> None:
         if not self.enabled or self._closed:
             return
+        # Mark closed FIRST: a signal handler landing on top of a
+        # running close() (atexit already mid-drain when SIGTERM
+        # arrives) must be a no-op re-entry, not a second drain.
+        self._closed = True
+        if self.watchdog is not None:
+            self.watchdog.stop()
         if self._ring or (self.ledger is not None
                           and self.ledger.has_pending()):
             # Drain buffered steps AND settle any trailing attributed
@@ -478,7 +638,14 @@ class Telemetry:
             self.drain()
         else:
             self._ensure_meta()
-        self._closed = True
+        # Terminal drain marker: its absence is how the report tool
+        # recognizes a truncated segment.
+        self._write({"kind": "final", "step": int(self.step_provider()),
+                     "ts": time.time()})
+        if self.flight is not None:
+            self.flight.closed_clean = True
+            self.flight.persist("close")
+            self.flight.uninstall()
         # Release process-lifetime anchors: the atexit hook keeps this
         # object (and anything its callbacks close over) alive, so a
         # closed Telemetry must unhook itself and drop the engine-side

@@ -42,15 +42,16 @@ class TraceWriter:
     the trace format explicitly permits — a crashed run's partial file
     still loads in Perfetto. Non-writer processes buffer nothing."""
 
-    def __init__(self, path: str, is_writer: Optional[bool] = None):
-        if is_writer is None:
-            try:
-                import jax
-                is_writer = jax.process_index() == 0
-            except Exception:
-                is_writer = True
-        self.path = path
-        self.is_writer = bool(is_writer)
+    def __init__(self, path: str, is_writer: Optional[bool] = None,
+                 per_host: bool = False, rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        # Shared writer resolution (monitor/hostinfo.py — the one copy
+        # of the process-0 guard); with per_host, non-zero ranks write
+        # their own ``<trace>.rankK.<ext>`` shard.
+        from .hostinfo import resolve_writer, shard_path
+        self.is_writer, self.rank, self.world = resolve_writer(
+            is_writer, per_host=per_host, rank=rank, world=world)
+        self.path = shard_path(path, self.rank if self.is_writer else 0)
         self._events: List[Dict[str, Any]] = []
         self._file = None
         self._t0 = time.perf_counter()

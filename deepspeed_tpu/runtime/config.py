@@ -76,6 +76,81 @@ class TensorboardConfig:
         self.job_name = get(d, C.TENSORBOARD_JOB_NAME, C.TENSORBOARD_JOB_NAME_DEFAULT)
 
 
+class TelemetryHealthConfig:
+    """The ``telemetry.health`` block (monitor/health.py + flight.py):
+    anomaly detection with NaN/Inf provenance, the hang watchdog, and
+    the crash flight recorder. Enabled by default whenever telemetry is
+    on — detection is drain-time host work; the watchdog (a daemon
+    thread) is the one opt-in."""
+
+    def __init__(self, d: Optional[Dict[str, Any]] = None):
+        d = d or {}
+        get = config_utils.get_scalar_param
+        self.enabled = get(d, C.TELEMETRY_HEALTH_ENABLED,
+                           C.TELEMETRY_HEALTH_ENABLED_DEFAULT)
+        self.grad_taps = get(d, C.TELEMETRY_HEALTH_GRAD_TAPS,
+                             C.TELEMETRY_HEALTH_GRAD_TAPS_DEFAULT)
+        self.z_threshold = get(d, C.TELEMETRY_HEALTH_Z_THRESHOLD,
+                               C.TELEMETRY_HEALTH_Z_THRESHOLD_DEFAULT)
+        self.ewma_alpha = get(d, C.TELEMETRY_HEALTH_EWMA_ALPHA,
+                              C.TELEMETRY_HEALTH_EWMA_ALPHA_DEFAULT)
+        self.warmup_steps = get(d, C.TELEMETRY_HEALTH_WARMUP_STEPS,
+                                C.TELEMETRY_HEALTH_WARMUP_STEPS_DEFAULT)
+        self.watchdog = get(d, C.TELEMETRY_HEALTH_WATCHDOG,
+                            C.TELEMETRY_HEALTH_WATCHDOG_DEFAULT)
+        self.watchdog_factor = get(
+            d, C.TELEMETRY_HEALTH_WATCHDOG_FACTOR,
+            C.TELEMETRY_HEALTH_WATCHDOG_FACTOR_DEFAULT)
+        self.watchdog_min_s = get(
+            d, C.TELEMETRY_HEALTH_WATCHDOG_MIN_S,
+            C.TELEMETRY_HEALTH_WATCHDOG_MIN_S_DEFAULT)
+        self.flight_recorder = get(d, C.TELEMETRY_HEALTH_FLIGHT,
+                                   C.TELEMETRY_HEALTH_FLIGHT_DEFAULT)
+        self.flight_path = get(d, C.TELEMETRY_HEALTH_FLIGHT_PATH,
+                               C.TELEMETRY_HEALTH_FLIGHT_PATH_DEFAULT)
+        self.flight_window = get(d, C.TELEMETRY_HEALTH_FLIGHT_WINDOW,
+                                 C.TELEMETRY_HEALTH_FLIGHT_WINDOW_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        blk = f"{C.TELEMETRY}.{C.TELEMETRY_HEALTH}"
+        for name, v in ((C.TELEMETRY_HEALTH_ENABLED, self.enabled),
+                        (C.TELEMETRY_HEALTH_GRAD_TAPS, self.grad_taps),
+                        (C.TELEMETRY_HEALTH_WATCHDOG, self.watchdog),
+                        (C.TELEMETRY_HEALTH_FLIGHT, self.flight_recorder)):
+            if not isinstance(v, bool):
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a bool, got {v!r}")
+        if not isinstance(self.z_threshold, (int, float)) or \
+                isinstance(self.z_threshold, bool) or self.z_threshold <= 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_HEALTH_Z_THRESHOLD} must be a "
+                f"positive number, got {self.z_threshold!r}")
+        if not isinstance(self.ewma_alpha, (int, float)) or \
+                isinstance(self.ewma_alpha, bool) or \
+                not (0.0 < float(self.ewma_alpha) <= 1.0):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_HEALTH_EWMA_ALPHA} must be in "
+                f"(0, 1], got {self.ewma_alpha!r}")
+        if not isinstance(self.warmup_steps, int) or self.warmup_steps < 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_HEALTH_WARMUP_STEPS} must be a "
+                f"non-negative int, got {self.warmup_steps!r}")
+        for name, v in ((C.TELEMETRY_HEALTH_WATCHDOG_FACTOR,
+                         self.watchdog_factor),
+                        (C.TELEMETRY_HEALTH_WATCHDOG_MIN_S,
+                         self.watchdog_min_s)):
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v <= 0:
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a positive number, got {v!r}")
+        if not isinstance(self.flight_window, int) or \
+                self.flight_window <= 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_HEALTH_FLIGHT_WINDOW} must be a "
+                f"positive int, got {self.flight_window!r}")
+
+
 class TelemetryConfig:
     """The ``telemetry`` block (monitor/ subsystem).
 
@@ -124,6 +199,9 @@ class TelemetryConfig:
                                C.TELEMETRY_PROFILE_DIR_DEFAULT)
         self.cost_model = get(d, C.TELEMETRY_COST_MODEL,
                               C.TELEMETRY_COST_MODEL_DEFAULT)
+        self.per_host_shards = get(d, C.TELEMETRY_PER_HOST,
+                                   C.TELEMETRY_PER_HOST_DEFAULT)
+        self.health = TelemetryHealthConfig(d.get(C.TELEMETRY_HEALTH))
         self._validate()
 
     def _validate(self) -> None:
@@ -150,6 +228,10 @@ class TelemetryConfig:
             raise DeepSpeedConfigError(
                 f"{C.TELEMETRY}.{C.TELEMETRY_COST_MODEL} must be a bool, "
                 f"got {self.cost_model!r}")
+        if not isinstance(self.per_host_shards, bool):
+            raise DeepSpeedConfigError(
+                f"{C.TELEMETRY}.{C.TELEMETRY_PER_HOST} must be a bool, "
+                f"got {self.per_host_shards!r}")
 
 
 class InferenceConfig:

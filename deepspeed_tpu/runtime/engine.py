@@ -584,6 +584,21 @@ class DeepSpeedEngine:
         # _maybe_build_cost_model.
         self._cost_model_built = False
 
+        # Health taps (monitor/health.py): the step programs return one
+        # [num_leaves] f32 array of per-leaf grad sum-of-squares that
+        # rides the telemetry ring to the batched drain fetch — NaN/Inf
+        # provenance (first non-finite leaf + layer) with zero added
+        # device syncs. The TapSpec decoding it is host metadata from
+        # the params tree.
+        self._health_tap_fn = None
+        hcfg = getattr(self.config.telemetry_config, "health", None)
+        if self.telemetry.enabled and self.telemetry.health is not None \
+                and hcfg is not None and hcfg.grad_taps:
+            from ..monitor.health import TapSpec, leaf_sq_taps
+            self.telemetry.set_tap_spec(TapSpec.from_tree(
+                self.state.params))
+            self._health_tap_fn = leaf_sq_taps
+
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
                  f"zero_stage={self.zero_optimization_stage()}", ranks=[0])
@@ -846,7 +861,8 @@ class DeepSpeedEngine:
                            cast_params=(params_sh if self._use_cast_cache
                                         else None))
 
-    def _metrics_shardings(self) -> Dict[str, NamedSharding]:
+    def _metrics_shardings(self, with_taps: bool = False
+                           ) -> Dict[str, NamedSharding]:
         """Replicated shardings for the step-metrics dict. Declared (with
         ``_state_shardings``) as out_shardings on every DONATING step
         program: without declared outputs, jax pairs donated inputs to
@@ -854,10 +870,15 @@ class DeepSpeedEngine:
         moments share global avals with the replicated params — the
         partitioner then drops the mispaired aliases and every
         param-sized donated buffer is freed-but-never-reused (the lint
-        suite's donation finding, a full param-tree of transient HBM)."""
+        suite's donation finding, a full param-tree of transient HBM).
+        ``with_taps`` adds the health tap's [num_leaves] entry (also
+        replicated) for paths that emit it."""
         scalar = NamedSharding(self.mesh, P())
-        return {k: scalar for k in ("loss", "grad_norm", "lr",
-                                    "loss_scale", "overflow")}
+        out = {k: scalar for k in ("loss", "grad_norm", "lr",
+                                   "loss_scale", "overflow")}
+        if with_taps:
+            out["health_leaf_sq"] = scalar
+        return out
 
     def _place_state(self, state: EngineState) -> EngineState:
         # Jitted identity, NOT device_put: device_put may alias caller-owned
@@ -1362,9 +1383,11 @@ class DeepSpeedEngine:
         fp16 = self.config.fp16_enabled
         scaler_kw = self._scaler_kw
         mask = self._sparse_mask
+        health_taps = self._health_tap_fn
 
         def apply_step(state, grads, sparse_overflow):
             scale = state.loss_scale
+            tap = None
             if fp16:
                 inv = 1.0 / scale
                 grads = jax.tree_util.tree_map(
@@ -1373,6 +1396,13 @@ class DeepSpeedEngine:
                                           tree_has_inf_or_nan(grads))
             else:
                 overflow = jnp.asarray(False)
+            # Health tap AFTER the unscale: here the whole tree is in
+            # true magnitudes (the CSR exchange already unscaled the
+            # sparse leaves host-side), so the reported per-layer norms
+            # match grad_norm semantics — and a NaN shipped through the
+            # CSR path is attributed too.
+            if health_taps is not None:
+                tap = health_taps(grads)
             grad_norm = global_norm(grads)
             # Same single-pass apply as the main step, clip folded in
             # (shared _clipped_update helper).
@@ -1389,12 +1419,14 @@ class DeepSpeedEngine:
             # DONATED, so reading state.loss_scale after this call would
             # touch a deleted buffer (the round-5 steps_per_print crash).
             return new_state, grad_norm, schedule_fn(state.step), overflow, \
-                scale
+                scale, tap
 
         scalar = NamedSharding(self.mesh, P())
         return jax.jit(apply_step, donate_argnums=(0,),
-                       out_shardings=(self._state_shardings,
-                                      scalar, scalar, scalar, scalar))
+                       out_shardings=(self._state_shardings, scalar,
+                                      scalar, scalar, scalar,
+                                      scalar if health_taps is not None
+                                      else None))
 
     def _csr_exchange(self, grads, inv_scale: float = 1.0):
         """Replace each sparse leaf's stacked per-rank grads [dp, V, H]
@@ -1463,10 +1495,13 @@ class DeepSpeedEngine:
                 grads, inv_scale=inv)
         self.sparse_comm_stats = {"sparse_elements": int(shipped),
                                   "dense_elements": int(dense_n)}
-        self.state, grad_norm, lr, overflow, scale_out = \
+        self.state, grad_norm, lr, overflow, scale_out, tap = \
             self._sparse_apply_fn(self.state, grads, jnp.asarray(sp_overflow))
-        return {"loss": loss, "grad_norm": grad_norm, "lr": lr,
-                "loss_scale": scale_out, "overflow": overflow}
+        metrics = {"loss": loss, "grad_norm": grad_norm, "lr": lr,
+                   "loss_scale": scale_out, "overflow": overflow}
+        if tap is not None:
+            metrics["health_leaf_sq"] = tap
+        return metrics
 
     # ------------------------------------------------------------------ #
     # The jitted train step
@@ -1719,6 +1754,7 @@ class DeepSpeedEngine:
         accepts_pld = self._accepts_pld
         use_cache = self._use_cast_cache
         master_free = self._master_free
+        health_taps = self._health_tap_fn
 
         def scaled_loss(params, mb, key, scale, theta):
             # With the cast cache, ``params`` arrive already in the compute
@@ -1806,6 +1842,20 @@ class DeepSpeedEngine:
                     accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
                     (micro_batches, keys))
 
+            # Health tap BEFORE the apply consumes the grads: one small
+            # stacked array of per-leaf sum-of-squares (non-finite entry
+            # == the overflow vote's information, with provenance). The
+            # grads are still loss-scaled here; dividing the tap by
+            # scale^2 (one scalar multiply on [L]) reports TRUE norms —
+            # anomaly events must match grad_norm semantics, not show
+            # 65536x-inflated layers. A finite scale preserves
+            # (non-)finiteness either way.
+            tap = None
+            if health_taps is not None:
+                tap = health_taps(grads)
+                if fp16:
+                    tap = tap / (scale * scale)
+
             sr_key = jax.random.fold_in(rng, 0x5352) if master_free \
                 else None
             if fused_step is not None:
@@ -1878,11 +1928,14 @@ class DeepSpeedEngine:
                 "loss_scale": scale,
                 "overflow": overflow,
             }
+            if tap is not None:
+                metrics["health_leaf_sq"] = tap
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,),
                        out_shardings=(self._state_shardings,
-                                      self._metrics_shardings()))
+                                      self._metrics_shardings(
+                                          with_taps=health_taps is not None)))
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
@@ -2303,8 +2356,12 @@ class DeepSpeedEngine:
         telemetry drain rides the same boundary discipline (its own
         report_steps cadence, defaulting to steps_per_print)."""
         if self.global_steps % max(1, self.steps_per_print()) == 0:
+            # Scalars only: the health tap rides metrics as a
+            # [num_leaves] array and is drain/event material, not a
+            # print-line field.
             m = {k: (float(jax.device_get(v)) if hasattr(v, "dtype") else v)
-                 for k, v in metrics.items()}
+                 for k, v in metrics.items()
+                 if getattr(v, "ndim", 0) == 0 or not hasattr(v, "dtype")}
             if m.get("grad_norm", 0.0) < 0:
                 # Sentinel: norm computation skipped (no clipping, no fp16) —
                 # don't surface a bogus value to logs/monitors.
@@ -2450,9 +2507,18 @@ class DeepSpeedEngine:
         fused_apply = self._fused_apply
         fused_step = self._fused_step
         use_cache = self._use_cast_cache
+        health_taps = self._health_tap_fn
 
         def apply_grads(state: EngineState, grads):
             scale = state.loss_scale
+            # Same in-graph health tap as the main train step — the trio
+            # applies the ACCUMULATED (still loss-scaled) grads, so
+            # provenance covers the whole window; unscale the tap so the
+            # reported norms are true magnitudes (scale traces as 1.0
+            # when not fp16).
+            tap = None
+            if health_taps is not None:
+                tap = health_taps(grads) / (scale * scale)
             if fused_step is not None:
                 # One-pass clipped update, same contract as the main
                 # train step: unscale (scale is a traced 1.0 when not
@@ -2490,6 +2556,8 @@ class DeepSpeedEngine:
             metrics = {"loss": raw_metric_placeholder(), "grad_norm": grad_norm,
                        "lr": schedule_fn(state.step), "loss_scale": scale,
                        "overflow": overflow}
+            if tap is not None:
+                metrics["health_leaf_sq"] = tap
             return new_state, metrics
 
         def raw_metric_placeholder():
@@ -2501,7 +2569,8 @@ class DeepSpeedEngine:
             "apply_grads",
             jax.jit(apply_grads, donate_argnums=(0,),
                     out_shardings=(self._state_shardings,
-                                   self._metrics_shardings())))
+                                   self._metrics_shardings(
+                                       with_taps=health_taps is not None))))
         return self._grad_step_fn
 
     # ------------------------------------------------------------------ #

@@ -28,11 +28,16 @@ tokens/s regresses on a relative drop beyond ``--serve-drop`` (default
 step changes, not jitter); the fused-kernel ablation speedup (the
 ``kernels.fused_speedup`` field a DS_BENCH_KERNELS=1 bench or
 ``ablate_fused_ln.py`` records) regresses on a relative drop beyond
-``--kernel-drop`` (default 10%). A metric missing on either side is skipped
-with a notice, never a failure — rounds recorded before this tool (or
-before the serving tier) existed have no such field, and the gate must
-not retroactively break them. Exit 0 = pass/skip, 1 = regression, 2 =
-usage error.
+``--kernel-drop`` (default 10%). A TELEMETRY.json carrying a ``health``
+section is additionally validated on the NEW side alone: UNSKIPPED
+non-finite anomalies (overflow-skipped steps are routine fp16
+loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
+died without its final drain marker) fail the round — those are not
+regressions to diff but defects to refuse. A metric missing on either
+side is skipped with a notice, never a failure — rounds recorded before
+this tool (or before the serving tier / health layer) existed have no
+such field, and the gate must not retroactively break them. Exit 0 =
+pass/skip, 1 = regression, 2 = usage error.
 
 Opt-in from CI: ``tools/run_tier1.sh --bench-gate`` (or BENCH_GATE=1).
 """
@@ -88,8 +93,25 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         ttft = srv.get("ttft_ms")
         if isinstance(ttft, dict) and ttft.get("p95") is not None:
             ttft_p95 = float(ttft["p95"])
+    # Health-layer TELEMETRY.json shape: validated (new side only), not
+    # diffed. Pre-health rounds carry no section -> None -> skipped.
+    health: Optional[Dict[str, Any]] = None
+    hl = doc.get("health")
+    if isinstance(hl, dict):
+        anom = hl.get("anomalies") or {}
+        # Gate on UNSKIPPED non-finite events only: overflow-skipped
+        # steps are routine fp16 dynamic-loss-scale mechanics (a healthy
+        # fp16 round backs its scale off without being a defect).
+        health = {
+            "truncated": bool(doc.get("truncated")
+                              or hl.get("truncated")),
+            "watchdog_fires": int(hl.get("watchdog_fires") or 0),
+            "nonfinite": int(anom.get("nonfinite_unskipped",
+                                      anom.get("nonfinite")) or 0),
+        }
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
-            "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup}
+            "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
+            "health": health}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -197,6 +219,31 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
                    if m["kernel_speedup"] is None]
         print(f"kernel fused speedup: skipped (no kernels record in "
               f"{', '.join(missing)})")
+
+    # Health validation: NEW side only (defects, not diffs). Pre-health
+    # rounds skip, never fail.
+    nh = new.get("health")
+    if nh is not None:
+        compared += 1
+        bad = []
+        if nh["truncated"]:
+            bad.append("stream truncated (no final drain marker)")
+        if nh["watchdog_fires"] > 0:
+            bad.append(f"{nh['watchdog_fires']} hang-watchdog fire(s)")
+        if nh["nonfinite"] > 0:
+            bad.append(f"{nh['nonfinite']} unskipped non-finite "
+                       f"anomaly event(s)")
+        verdict = "OK" if not bad else "FAIL"
+        print(f"health: {name_new}: "
+              + ("; ".join(bad) if bad else
+                 "no non-finite anomalies, no watchdog fires, "
+                 "final marker present")
+              + f": {verdict}")
+        if bad:
+            rc = 1
+    else:
+        print(f"health: skipped (no health section in {name_new} — "
+              "pre-health round)")
 
     if compared == 0:
         print("bench_gate: nothing comparable between the two files "

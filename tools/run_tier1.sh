@@ -6,12 +6,16 @@
 #   --lint / LINT_GATE=1 : run tools/ds_lint.py --check over the flagship
 #       configs — fail on any unwaived finding OR stale waiver
 #       (tools/lint_waivers.json is the baseline).
+#   --health / HEALTH_GATE=1 : run the dp=8 health self-check
+#       (tools/health_check.py): induced-NaN provenance, flight
+#       recorder + final marker, zero added hot-path device syncs.
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 for arg in "$@"; do
   case "$arg" in
     --bench-gate) BENCH_GATE=1 ;;
     --lint) LINT_GATE=1 ;;
+    --health) HEALTH_GATE=1 ;;
   esac
 done
 if [ "${BENCH_GATE:-0}" = "1" ]; then
@@ -19,5 +23,8 @@ if [ "${BENCH_GATE:-0}" = "1" ]; then
 fi
 if [ "${LINT_GATE:-0}" = "1" ]; then
   python tools/ds_lint.py --check || rc=1
+fi
+if [ "${HEALTH_GATE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python tools/health_check.py || rc=1
 fi
 exit $rc

@@ -33,18 +33,34 @@ so benches and CI can compare runs:
   over decode iterations, TTFT/TPOT p50/p95 from ``request_complete``
   events, tokens/s and decode-step percentiles from the last report's
   aggregator snapshot.
+- ``health``: anomaly counts (non-finite provenance events, EWMA
+  spikes), watchdog fires, flight-recorder presence (FLIGHT.json next
+  to the stream, with its recorded reason), the ``truncated`` verdict,
+  and multi-host aggregation over per-host shards
+  (``<job>.rankK.jsonl``): per-host step-wall p50 with straggler skew,
+  step-count desync, and loss-hash desync (SPMD processes must see the
+  same loss — a differing hash means the pod diverged).
+- ``truncated`` (top level): a marker-capable stream (meta
+  ``emits_final``) whose latest segment lacks the terminal ``final``
+  record ended in a crash/kill — its window stats describe a PARTIAL
+  run and are labeled so instead of being reported as a complete one.
+  Pre-marker streams get ``null`` (unknown), never a false verdict.
 
 ``tools/bench_gate.py`` diffs the mfu/goodput sections across bench
 rounds — and the serving section across serving rounds — and fails CI
-on regression.
+on regression; a ``health`` section with non-finite anomalies, watchdog
+fires, or a truncated stream fails the round outright.
 """
 from __future__ import annotations
 
 import argparse
+import glob
+import hashlib
 import json
 import os
+import re
 import sys
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -57,17 +73,19 @@ def _percentile(sorted_vals: List[float], q: float) -> float:
     return float(sorted_vals[k])
 
 
-def summarize(jsonl_path: str) -> Dict[str, Any]:
-    """Summary of the LATEST run in the stream: the sink appends (so a
-    resumed/re-launched job with the same job_name extends one file), and
-    every run opens with a ``meta`` record — seeing one resets the
-    accumulators so earlier runs' steps can't contaminate this run's
-    percentiles, recompile counts, or consistency checks."""
+def _parse_segment(jsonl_path: str) -> Tuple[Dict[str, Any],
+                                             List[Dict[str, Any]],
+                                             List[Dict[str, Any]],
+                                             List[Dict[str, Any]],
+                                             Dict[str, Any], bool]:
+    """(meta, steps, reports, events, cost_model, saw_final) of the
+    LATEST segment in an append-mode stream (a meta record resets)."""
     meta: Dict[str, Any] = {}
     steps: List[Dict[str, Any]] = []
     reports: List[Dict[str, Any]] = []
     events: List[Dict[str, Any]] = []
     cost_model: Dict[str, Any] = {}
+    saw_final = False
     with open(jsonl_path) as f:
         for line in f:
             line = line.strip()
@@ -81,6 +99,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             if kind == "meta":
                 meta, steps, reports, events = dict(rec), [], [], []
                 cost_model = {}
+                saw_final = False
             elif kind == "step":
                 steps.append(rec)
             elif kind == "report":
@@ -89,6 +108,104 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
                 events.append(rec)
             elif kind == "cost_model":
                 cost_model = dict(rec)
+            elif kind == "final":
+                saw_final = True
+    return meta, steps, reports, events, cost_model, saw_final
+
+
+def _loss_hash(steps: List[Dict[str, Any]]) -> Optional[str]:
+    """Order-sensitive digest of the (rounded) loss series — SPMD
+    processes compute the same global loss, so differing hashes across
+    host shards mean the pod DIVERGED (desync), the check no per-host
+    eyeball could do."""
+    losses = [round(float(r["loss"]), 5) for r in steps
+              if isinstance(r.get("loss"), (int, float))
+              and not isinstance(r.get("loss"), bool)]
+    if not losses:
+        return None
+    return hashlib.md5(json.dumps(losses).encode()).hexdigest()[:12]
+
+
+def _host_entry(rank: int, steps: List[Dict[str, Any]],
+                saw_final: bool) -> Dict[str, Any]:
+    walls = sorted(float(r["wall_ms"]) for r in steps if "wall_ms" in r)
+    return {"rank": rank, "steps": len(steps),
+            "last_step": steps[-1].get("step") if steps else None,
+            "wall_p50_ms": round(_percentile(walls, 50), 3),
+            "loss_hash": _loss_hash(steps),
+            "final": bool(saw_final)}
+
+
+def aggregate_hosts(jsonl_path: str, meta: Dict[str, Any],
+                    steps: List[Dict[str, Any]],
+                    saw_final: bool) -> Dict[str, Any]:
+    """Cross-host view from the per-host shards next to the primary
+    stream: straggler skew (per-host step-wall p50 spread), step-count
+    desync, and loss-hash desync."""
+    root, ext = os.path.splitext(jsonl_path)
+    shard_paths = sorted(glob.glob(f"{root}.rank*{ext}"))
+    entries = [_host_entry(int(meta.get("process_index", 0) or 0),
+                           steps, saw_final)]
+    # Stale-shard guard: the sink appends, so a relaunch with a smaller
+    # world (or per_host_shards off) leaves orphaned rank files whose
+    # LAST segment belongs to the previous run — comparing them against
+    # the new primary would fabricate desync/straggler verdicts. A shard
+    # is stale when its rank falls outside the primary's process_count,
+    # or its segment-start ts is far (>15 min) from the primary's —
+    # SPMD processes of one run start near-simultaneously.
+    primary_ts = float(meta.get("ts") or 0.0)
+    pcount = int(meta.get("process_count") or 0)
+    stale: List[Dict[str, Any]] = []
+    for p in shard_paths:
+        m = re.search(r"\.rank(\d+)" + re.escape(ext) + "$", p)
+        meta_s, steps_s, _, _, _, fin_s = _parse_segment(p)
+        rank = int(meta_s.get("process_index",
+                              m.group(1) if m else -1) or 0)
+        ts_s = float(meta_s.get("ts") or 0.0)
+        reason = None
+        if pcount and rank >= pcount:
+            reason = f"rank {rank} outside process_count {pcount}"
+        elif primary_ts and ts_s and abs(ts_s - primary_ts) > 900.0:
+            reason = "segment start >15min from the primary's"
+        if reason is not None:
+            stale.append({"rank": rank, "path": os.path.basename(p),
+                          "reason": reason})
+            continue
+        entries.append(_host_entry(rank, steps_s, fin_s))
+    out: Dict[str, Any] = {"available": len(entries) > 1,
+                           "n_hosts": len(entries)}
+    if stale:
+        out["stale_shards"] = stale
+    if len(entries) < 2:
+        return out
+    entries.sort(key=lambda e: e["rank"])
+    p50s = [e["wall_p50_ms"] for e in entries if e["wall_p50_ms"] > 0]
+    skew = None
+    slowest = None
+    if p50s and min(p50s) > 0:
+        skew = round((max(p50s) - min(p50s)) / min(p50s), 4)
+        slowest = max((e for e in entries if e["wall_p50_ms"] > 0),
+                      key=lambda e: e["wall_p50_ms"])["rank"]
+    lasts = {e["last_step"] for e in entries if e["last_step"] is not None}
+    hashes = {e["loss_hash"] for e in entries if e["loss_hash"]}
+    out.update({
+        "per_host": entries,
+        "straggler_skew_rel": skew,
+        "slowest_rank": slowest,
+        "step_count_desync": len(lasts) > 1,
+        "loss_desync": len(hashes) > 1,
+    })
+    return out
+
+
+def summarize(jsonl_path: str) -> Dict[str, Any]:
+    """Summary of the LATEST run in the stream: the sink appends (so a
+    resumed/re-launched job with the same job_name extends one file), and
+    every run opens with a ``meta`` record — seeing one resets the
+    accumulators so earlier runs' steps can't contaminate this run's
+    percentiles, recompile counts, or consistency checks."""
+    meta, steps, reports, events, cost_model, saw_final = \
+        _parse_segment(jsonl_path)
 
     walls = sorted(float(r["wall_ms"]) for r in steps if "wall_ms" in r)
     recompiles = [e for e in events if e.get("event") == "recompile"]
@@ -253,6 +370,85 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "decode_tokens": serve_snap.get("decode_tokens"),
         })
 
+    # Truncation: a marker-capable segment without the terminal `final`
+    # record died mid-run — its partial-window stats must not read as a
+    # complete run. Pre-marker streams: unknown (None), never a false
+    # verdict.
+    truncated: Optional[bool] = (not saw_final) \
+        if meta.get("emits_final") else None
+    if truncated:
+        goodput["truncated"] = True
+        mfu["truncated"] = True
+
+    # Health: anomaly/watchdog events, flight-recorder presence, and
+    # the multi-host shard aggregation.
+    anomalies = [e for e in events if e.get("event") == "anomaly"]
+    watchdogs = [e for e in events if e.get("event") == "watchdog"]
+    counts: Dict[str, int] = {}
+    nonfinite = 0
+    nonfinite_unskipped = 0
+    for a in anomalies:
+        k = str(a.get("anomaly", "unknown"))
+        counts[k] = counts.get(k, 0) + 1
+        if k.startswith("nonfinite"):
+            nonfinite += 1
+            # Overflow-SKIPPED steps are routine fp16 loss-scale
+            # mechanics (update discarded); a non-finite value that was
+            # NOT skipped entered the params/loss — the defect class.
+            if not a.get("overflow"):
+                nonfinite_unskipped += 1
+    flight: Dict[str, Any] = {"present": False}
+    stream_dir = os.path.dirname(os.path.abspath(jsonl_path))
+    candidates = []
+    meta_fp = meta.get("flight_path")
+    if meta_fp:
+        # The recorded path may be relative to the RUN's cwd, not ours;
+        # fall back to the same basename next to the analyzed stream.
+        # No meta flight_path = this segment never armed a recorder —
+        # do NOT glob for an artifact, or a previous run's crash file
+        # in the same directory gets attributed to a clean run.
+        candidates.append(meta_fp)
+        candidates.append(os.path.join(stream_dir,
+                                       os.path.basename(meta_fp)))
+    fpath = next((c for c in candidates if os.path.exists(c)), None)
+    if fpath:
+        flight = {"present": True, "path": fpath}
+        try:
+            with open(fpath) as f:
+                fdoc = json.load(f)
+            flight.update({"reason": fdoc.get("reason"),
+                           "closed_clean": fdoc.get("closed_clean"),
+                           "last_steps": len(fdoc.get("last_steps") or []),
+                           "watchdog_fires": fdoc.get("watchdog_fires")})
+        except (OSError, json.JSONDecodeError):
+            flight["parse_error"] = True
+    hosts = aggregate_hosts(jsonl_path, meta, steps, saw_final)
+    health: Dict[str, Any] = {
+        "available": bool(meta.get("health_enabled")) or bool(anomalies)
+        or bool(watchdogs),
+        "anomalies": {
+            "total": len(anomalies),
+            "nonfinite": nonfinite,
+            "nonfinite_unskipped": nonfinite_unskipped,
+            "counts": counts,
+            # `anomaly_step` is the step the anomaly happened AT;
+            # the record's `step` field is the drain-time counter and
+            # would mislabel every anomaly in a window with the report
+            # boundary's step.
+            "events": [dict(
+                {k: a.get(k) for k in
+                 ("anomaly", "first_nonfinite_leaf",
+                  "first_nonfinite_layer", "overflow", "metric", "z")
+                 if k in a},
+                step=a.get("anomaly_step", a.get("step")))
+                for a in anomalies[:8]],
+        },
+        "watchdog_fires": len(watchdogs),
+        "flight_recorder": flight,
+        "truncated": truncated,
+        "hosts": hosts,
+    }
+
     offload_steps = [r["offload"] for r in steps
                      if isinstance(r.get("offload"), dict)]
     offload: Optional[Dict[str, Any]] = None
@@ -301,6 +497,8 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "roofline": roofline,
         "goodput": goodput,
         "serving": serving,
+        "health": health,
+        "truncated": truncated,
     }
 
 
@@ -319,6 +517,14 @@ def main(argv=None) -> int:
     gp = summary["goodput"].get("goodput_fraction")
     bound = summary["roofline"].get("step_bound")
     srv = summary["serving"]
+    hl = summary["health"]
+    health_bits = ""
+    if hl.get("available"):
+        health_bits = (f", anomalies={hl['anomalies']['total']}, "
+                       f"watchdog={hl['watchdog_fires']}")
+        if hl["hosts"].get("available"):
+            health_bits += (f", hosts={hl['hosts']['n_hosts']} "
+                            f"(skew={hl['hosts'].get('straggler_skew_rel')})")
     print(f"{args.output}: {summary['steps_recorded']} steps, "
           f"p50={st['p50']}ms p95={st['p95']}ms, "
           f"recompiles={summary['recompiles']['count']}, "
@@ -328,7 +534,10 @@ def main(argv=None) -> int:
           + (f", goodput={gp:.1%}" if gp is not None else "")
           + (f", serving: occ={srv['occupancy_mean']}, "
              f"ttft p50={srv['ttft_ms']['p50']}ms"
-             if srv.get("available") else ""))
+             if srv.get("available") else "")
+          + health_bits
+          + (" — TRUNCATED segment (no final drain marker): stats "
+             "cover a partial run" if summary["truncated"] else ""))
     return 0
 
 
