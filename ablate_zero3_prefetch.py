@@ -1,0 +1,205 @@
+"""ZeRO-3 prefetch ablation: prefetch_depth 0 vs 1 (ISSUE 11, dev tool).
+
+Runs the stage-3 engine with the prefetched layer scan
+(runtime/zero/stage3.py) on the dp=8 CPU mesh at ``prefetch_depth`` 0
+(gather at use — the parity baseline) and 1 (the scan carries one
+gathered layer so layer i+1's all-gather overlaps layer i's compute),
+and records:
+
+- **measured** CPU wall times for both depths — honestly labeled: on
+  the emulated mesh the "interconnect" is memcpy, so the measured delta
+  exercises the schedule, not ICI latency hiding. Parity (identical
+  losses across depths) is asserted here, because a prefetch knob that
+  changes numerics is a bug, not a tuning.
+- the **analytic overlap fraction** on the target chip: per layer, the
+  gather moves ``(g-1)/g · layer_bytes`` (compute dtype) over ICI while
+  the previous layer computes ``layer_flops`` on the MXU; depth 1 hides
+  ``min(t_gather, t_compute) / t_gather`` of the gather wall, depth 0
+  hides nothing. Chip peaks come from monitor/peaks.py (v5e default on
+  CPU, labeled assumed).
+- the **analytic memory headroom**: per-device state bytes under stage
+  2 vs stage 3 (+ the bounded gather working set), i.e. how much of the
+  replicated-param footprint stage 3 returns — the capacity that lets a
+  single slice hold past-10B-param models (ROADMAP item 1).
+
+``--record`` writes ZERO3_BENCH.json; ``tools/bench_gate.py`` parses
+its ``zero3.overlap_fraction`` (shape-tested in tests/test_zero3.py).
+
+Usage: python ablate_zero3_prefetch.py [--layers N] [--record]
+"""
+import dataclasses
+import json
+import os
+import sys
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        _flags + " --xla_force_host_platform_device_count=8"
+
+import jax                     # noqa: E402
+import jax.numpy as jnp        # noqa: E402
+import numpy as np             # noqa: E402
+
+import deepspeed_tpu           # noqa: E402
+from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,  # noqa: E402
+                                       gpt2_loss_fn)
+from deepspeed_tpu.monitor.memory import analytic_state_bytes  # noqa: E402
+from deepspeed_tpu.monitor.peaks import chip_peaks  # noqa: E402
+from deepspeed_tpu.runtime.zero.stage3 import (Zero3Scan,  # noqa: E402
+                                               gather_working_set_bytes)
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+OUT = os.path.join(REPO, "ZERO3_BENCH.json")
+RECORD = "--record" in sys.argv
+LAYERS = 4
+if "--layers" in sys.argv:
+    LAYERS = int(sys.argv[sys.argv.index("--layers") + 1])
+
+
+def build_engine(depth: int, stage: int = 3):
+    cfg = dataclasses.replace(
+        GPT2_CONFIGS["gpt2-tiny"], num_layers=LAYERS, dtype=jnp.float32,
+        hidden_dropout=0.0, attn_dropout=0.0, fused_kernels=False)
+    spec = Zero3Scan() if stage >= 3 else None
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    ds_cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": stage,
+                                    "prefetch_depth": depth},
+              "steps_per_print": 10 ** 9}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2_loss_fn(cfg, zero3=spec), model_params=params,
+        config=ds_cfg, zero3_scan=spec)
+    return engine, cfg
+
+
+def measure(depth: int, steps: int = 8):
+    engine, cfg = build_engine(depth)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          size=(16, 33)).astype(np.int32)
+    losses = [float(engine.train_batch(batch=tokens))
+              for _ in range(2)]           # compile + warm
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        losses.append(float(engine.train_batch(batch=tokens)))
+    wall = (time.perf_counter() - t0) / steps
+    return {"prefetch_depth": depth, "step_ms": round(wall * 1e3, 3),
+            "losses": losses}, engine, cfg
+
+
+def analytic(engine, cfg):
+    """Chip-model overlap + memory headroom (no measurement)."""
+    peaks = chip_peaks()
+    dp = engine.dp_size
+    blocks = jax.device_get(engine.state.params)["blocks"]
+    layer_bytes = sum(int(np.prod(l.shape)) // l.shape[0] * 4
+                      for l in jax.tree_util.tree_leaves(blocks))
+    gather_bytes = (dp - 1) * layer_bytes // dp
+    # Per-layer forward matmul FLOPs (the compute the depth-1 gather
+    # overlaps): 2 * tokens * per-layer matmul params.
+    H, F = cfg.hidden_size, cfg.ffn_size
+    layer_mm_params = 4 * H * H + 2 * H * F
+    tokens_per_dev = 16 * 32 // dp
+    layer_flops = 2 * tokens_per_dev * layer_mm_params
+    t_gather = gather_bytes / peaks.ici_bytes_per_sec
+    t_compute = layer_flops / peaks.flops_per_sec
+    overlap = {0: 0.0,
+               1: round(min(t_gather, t_compute) / max(t_gather, 1e-12),
+                        4)}
+    # Memory headroom: stage-2 per-device state vs stage-3 (+ gather
+    # working set at depth 1).
+    e2, _ = build_engine(1, stage=2)
+    s2 = analytic_state_bytes(e2.state)
+    spec = engine._zero3_scan_spec
+    ws = gather_working_set_bytes(
+        engine.state.params, engine._stage3_specs, "data",
+        jnp.dtype(engine.compute_dtype).itemsize, prefetch_depth=1,
+        scan_paths=spec.covers if spec is not None else None)
+    s3 = analytic_state_bytes(engine.state, gather_working_set=ws)
+    return {
+        "chip": {"name": peaks.name, "assumed": peaks.assumed},
+        "per_layer_gather_bytes": int(gather_bytes),
+        "per_layer_compute_flops": int(layer_flops),
+        "t_gather_us": round(t_gather * 1e6, 3),
+        "t_compute_us": round(t_compute * 1e6, 3),
+        "overlap_fraction_by_depth": overlap,
+        "memory": {
+            "stage2_state_bytes_per_device": int(s2),
+            "stage3_state_bytes_per_device": int(s3),
+            "gather_working_set_bytes": int(ws),
+            "headroom_fraction": round(1.0 - s3 / max(1, s2), 4),
+        },
+    }
+
+
+def production_projection(model: str = "gpt2-large", mbs: int = 4,
+                          dp: int = 8):
+    """Pure config arithmetic at a production shape: per-layer bf16
+    gather vs per-layer fwd compute at the chip peaks — the overlap the
+    depth-1 prefetch buys on real hardware (the toy mesh above cannot
+    show it: its per-layer compute is microseconds)."""
+    cfg = GPT2_CONFIGS[model]
+    peaks = chip_peaks()
+    H, F = cfg.hidden_size, cfg.ffn_size
+    layer_params = 4 * H * H + 2 * H * F
+    gather_bytes = (dp - 1) * layer_params * 2 // dp    # bf16 wire
+    tokens = mbs * cfg.max_seq_length
+    layer_flops = 2 * tokens * layer_params
+    t_gather = gather_bytes / peaks.ici_bytes_per_sec
+    t_compute = layer_flops / peaks.flops_per_sec
+    return {
+        "model": model, "micro_batch": mbs, "dp": dp,
+        "chip": {"name": peaks.name, "assumed": peaks.assumed},
+        "per_layer_gather_bytes_bf16": int(gather_bytes),
+        "t_gather_us": round(t_gather * 1e6, 2),
+        "t_compute_us": round(t_compute * 1e6, 2),
+        "overlap_fraction_depth1":
+            round(min(t_gather, t_compute) / max(t_gather, 1e-12), 4),
+    }
+
+
+def main():
+    r0, _, _ = measure(0)
+    r1, engine, cfg = measure(1)
+    if r0["losses"] != r1["losses"]:
+        print("PARITY FAILURE: prefetch_depth changed the trajectory",
+              r0["losses"], r1["losses"])
+        return 1
+    ana = analytic(engine, cfg)
+    proj = production_projection()
+    record = {
+        "generated_by": "ablate_zero3_prefetch.py",
+        "mesh": {"devices": jax.device_count(),
+                 "backend": jax.devices()[0].platform},
+        "layers": LAYERS,
+        "measured_cpu": {
+            "note": "CPU-mesh walls exercise the schedule, not ICI "
+                    "latency hiding; parity (bit-identical losses "
+                    "across depths) is the load-bearing assertion here",
+            "depth0": {k: r0[k] for k in ("prefetch_depth", "step_ms")},
+            "depth1": {k: r1[k] for k in ("prefetch_depth", "step_ms")},
+            "parity": True,
+        },
+        "analytic": ana,
+        "production_projection": proj,
+        "zero3": {
+            "overlap_fraction": proj["overlap_fraction_depth1"],
+            "memory_headroom_fraction":
+                ana["memory"]["headroom_fraction"],
+        },
+        "projected": True,
+    }
+    print(json.dumps(record, indent=1))
+    if RECORD:
+        with open(OUT, "w") as f:
+            json.dump(record, f, indent=1)
+        print(f"wrote {OUT}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -19,7 +19,8 @@ from .utils.logging import logger, log_dist
 def initialize(args=None, model=None, optimizer=None, model_params=None,
                training_data=None, lr_scheduler=None, mpu=None,
                dist_init_required=None, collate_fn=None, config=None,
-               config_params=None, rng=None, param_shardings=None, mesh=None):
+               config_params=None, rng=None, param_shardings=None, mesh=None,
+               zero3_scan=None):
     """Initialize the engine. Parity with reference ``__init__.py:50``.
 
     Returns a tuple of ``(engine, optimizer, dataloader, lr_scheduler)``.
@@ -52,7 +53,8 @@ def initialize(args=None, model=None, optimizer=None, model_params=None,
                                  lr_scheduler=lr_scheduler, mpu=mpu,
                                  dist_init_required=dist_init_required,
                                  collate_fn=collate_fn, config=cfg, rng=rng,
-                                 param_shardings=param_shardings, mesh=mesh)
+                                 param_shardings=param_shardings, mesh=mesh,
+                                 zero3_scan=zero3_scan)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
 

@@ -53,9 +53,16 @@ def materialization_pass(ctx: LintContext) -> List[LintFinding]:
     # A buffer the size of ONE full (unsharded) leaf is inherent to any
     # lowering (a per-micro-batch gradient before its scatter, a ZeRO-3
     # per-layer gather) — the invariant this pass guards is TREE-scale
-    # materialization, so the largest single leaf is exempt.
+    # materialization, so the largest single leaf is exempt. Stage-3
+    # engines additionally budget their declared gather working set
+    # (``zero3_gather_bytes``: the compute-dtype leaf-at-use gathers, or
+    # prefetch_depth+1 layers on the scan path) — peak live buffers must
+    # stay under declared per-device state + that bound, NEVER the full
+    # fp32 master tree (the stage-3 correctness gate; a concat of
+    # gathered leaves into one tree-scale buffer still fires).
     thresh = max(int(ctx.config.materialize_floor_bytes),
-                 int(ctx.config.materialize_fraction * declared),
+                 int(ctx.config.materialize_fraction * declared)
+                 + int(ctx.meta.get("zero3_gather_bytes") or 0),
                  int(ctx.meta.get("largest_leaf_bytes") or 0))
     # Aggregate by largest-buffer SHAPE: one oversized buffer flows
     # through many opcodes (broadcast -> fusion -> copy -> ...); the

@@ -324,6 +324,14 @@ ZERO_REDUCE_SCATTER_DEFAULT = True
 ZERO_GRAD_SYNC = "grad_sync"
 ZERO_GRAD_SYNC_DEFAULT = "auto"
 ZERO_GRAD_SYNC_MODES = ("auto", "declarative", "explicit")
+# ZeRO-3 layer-gather prefetch: how many layers ahead the per-layer
+# param all-gather is issued inside the model's layer scan (runtime/zero/
+# stage3.py). 0 = gather at use (the parity baseline: no overlap
+# structure); k >= 1 = the scan carries k gathered layers so layer i+k's
+# gather overlaps layer i's compute. Only the stacked-layer scan path
+# consumes the knob; unstacked models gather leaf-at-use regardless.
+ZERO_PREFETCH_DEPTH = "prefetch_depth"
+ZERO_PREFETCH_DEPTH_DEFAULT = 1
 ZERO_OVERLAP_COMM = "overlap_comm"
 ZERO_OVERLAP_COMM_DEFAULT = False
 ZERO_ALLGATHER_PARTITIONS = "allgather_partitions"

@@ -77,14 +77,18 @@ def gpt2_param_shardings(cfg: GPT2Config, mp_axis: str = "model") -> Dict[str, A
 
 def gpt2_hidden(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
                 rng: Optional[jax.Array] = None, deterministic: bool = True,
-                attention_fn=None, pld_theta=None) -> jnp.ndarray:
-    """tokens [B, S] int32 → final hidden states [B, S, H] (post ln_f)."""
+                attention_fn=None, pld_theta=None, zero3=None) -> jnp.ndarray:
+    """tokens [B, S] int32 → final hidden states [B, S, H] (post ln_f).
+
+    ``zero3``: a bound ``Zero3Scan`` — the stacked block params arrive
+    as ZeRO-3 dp shards and are gathered per layer inside the scan
+    (prefetch-overlapped); see models/transformer.apply_blocks."""
     B, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens] + \
         params["wpe"].astype(cfg.dtype)[None, :S]
     x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
                      deterministic=deterministic, attention_fn=attention_fn,
-                     pld_theta=pld_theta)
+                     pld_theta=pld_theta, zero3=zero3)
     return layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
 
 
@@ -128,7 +132,7 @@ def gpt2_logits_at(params: Dict[str, Any], tokens: jnp.ndarray,
     return h @ params["wte"].astype(h.dtype).T
 
 
-def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
+def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None, zero3=None):
     """Returns loss_fn(params, batch, rng) for the engine.
 
     batch: tokens [B, S+1] (inputs are [:, :-1], targets [:, 1:]) or a
@@ -137,6 +141,11 @@ def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
     The CE head runs through ops.cross_entropy.chunked_softmax_xent, so the
     [tokens, vocab] fp32 logits tensor is never materialized (chunked
     recompute in backward — see that module's docstring).
+
+    ``zero3``: pass the SAME ``Zero3Scan`` object here and to
+    ``deepspeed_tpu.initialize(..., zero3_scan=...)`` — the engine binds
+    the stage-3 layout at construction, the loss reads it at trace time
+    and gathers the stacked block params per layer inside the scan.
     """
     from ..ops.cross_entropy import chunked_softmax_xent
 
@@ -146,7 +155,8 @@ def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None):
         else:
             tokens, targets = batch[:, :-1], batch[:, 1:]
         x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=False,
-                        attention_fn=attention_fn, pld_theta=pld_theta)
+                        attention_fn=attention_fn, pld_theta=pld_theta,
+                        zero3=zero3)
         B, S = tokens.shape
         return chunked_softmax_xent(x.reshape(B * S, -1),
                                     params["wte"].astype(cfg.dtype),

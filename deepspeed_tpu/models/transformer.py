@@ -339,8 +339,20 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
                  deterministic: bool = True,
                  attention_fn: Optional[AttentionFn] = None,
                  pld_theta: Optional[jnp.ndarray] = None,
-                 layer_valid: Optional[jnp.ndarray] = None) -> jnp.ndarray:
+                 layer_valid: Optional[jnp.ndarray] = None,
+                 zero3=None) -> jnp.ndarray:
     """Run all L layers via lax.scan over the stacked leading axis.
+
+    ``zero3`` (a bound ``runtime.zero.stage3.Zero3Scan``) reroutes the
+    layer loop through the ZeRO-3 prefetched scan: the stacked params
+    arrive as dp SHARDS, each layer's slice is all-gathered
+    ``prefetch_depth`` layers ahead of use inside the scan (the gather
+    overlaps the previous layer's compute), dropped right after its
+    fwd/bwd consumption, and its grads reduce-scattered back to the
+    owning shard inside the backward scan. Does not compose with
+    ``pld_theta``/``layer_valid`` (the manual-VJP scan has no per-layer
+    skip) or ``scan_layers=False``; ``remat_policy`` is subsumed — the
+    backward re-gathers and recomputes each layer by construction.
 
     ``pld_theta`` (traced scalar in (0, 1]) enables progressive layer drop
     (reference progressive_layer_drop.py:29-37 + the PLD paper's
@@ -364,6 +376,19 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
 
     block = partial(transformer_block, cfg=cfg, mask=mask,
                     deterministic=deterministic, attention_fn=attention_fn)
+
+    if zero3 is not None and getattr(zero3, "bound", False):
+        if pld_theta is not None or layer_valid is not None:
+            raise ValueError(
+                "zero3 layer scan does not compose with progressive "
+                "layer drop or padded layer_valid slots")
+        if not cfg.scan_layers:
+            raise ValueError("zero3 layer scan requires scan_layers=True")
+        from ..runtime.zero.stage3 import zero3_block_scan
+
+        def block_fn(p, h, key):
+            return block(p, h, rng=key if use_rng else None)
+        return zero3_block_scan(block_fn, stacked, x, keys, zero3)
     policy = _remat_policy(cfg.remat_policy)
     if cfg.remat_policy != "none":
         block = jax.checkpoint(

@@ -58,13 +58,22 @@ def device_memory_stats(devices=None) -> Optional[Dict[str, Any]]:
     return out
 
 
-def analytic_state_bytes(tree: Any) -> int:
+def analytic_state_bytes(tree: Any, gather_working_set: int = 0) -> int:
     """Per-device bytes of ``tree`` (max across devices, from sharding
     metadata — no device access). Unsharded/unaddressable leaves count
-    their full size."""
+    their full size.
+
+    Each leaf is priced at ITS OWN sharding's shard shape, so ZeRO-3's
+    dp-sharded parameters contribute params/dp — the true per-device
+    footprint, never the replicated-param figure. ``gather_working_set``
+    adds the stage-3 transient gather bound (compute-dtype gathered
+    leaves live during the step: ``zero/stage3.gather_working_set_bytes``)
+    so the watermark threshold and the telemetry_report memory section
+    compare the measured peak against what a healthy stage-3 step
+    actually holds, not just the resident state."""
     import jax
     import numpy as np
-    total = 0
+    total = int(gather_working_set)
     for leaf in jax.tree_util.tree_leaves(tree):
         shape = getattr(leaf, "shape", None)
         dtype = getattr(leaf, "dtype", None)

@@ -59,7 +59,16 @@ v-th 1/V slice of every leaf, so:
   param all-gather);
 - the layout does not depend on dp (``V`` is a constant 8, widened to
   dp only above 8 devices), so checkpoints stay elastic across dp
-  resizes exactly like PR-1's.
+  resizes exactly like PR-1's;
+- under ZeRO-3 (params THEMSELVES dp-sharded, runtime/zero/stage3.py)
+  the apply needs NO new gather: a leaf sharded on its leading dim over
+  dp owns contiguous flat ranges, which are exactly whole virtual rows
+  (``V/dp`` rows = the d-th 1/dp of every leaf), so the
+  ``_flatten_group`` row constraint is a local reshape and the kernels
+  consume grad, param AND moments as the same dp shard — verified by
+  COMM_AUDIT.json's zero3 config (zero apply-time collectives). Leaves
+  the stage-3 layer scan shards on a non-leading dim relayout at region
+  entry (still 1/dp per device, never a gather to full).
 
 The deterministic math is bit-exact with ``optax.adamw`` / the engine's
 coupled-Adam chain: every multiply-add is written in optax's association

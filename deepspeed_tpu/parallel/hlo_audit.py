@@ -306,29 +306,78 @@ def zero2_grad_sync_lowering(mesh, axis_name: str = "data",
 
 
 def grad_sync_wire_model(params: Any, dp: int,
-                         grad_bytes_per_el: int = 4) -> Dict[str, int]:
+                         grad_bytes_per_el: int = 4,
+                         zero3: bool = False,
+                         param_bytes_per_el: Optional[int] = None,
+                         gas: int = 1,
+                         param_specs: Any = None,
+                         mesh: Any = None) -> Dict[str, int]:
     """Analytic per-step gradient-sync wire bytes for a param tree under
     dp-way data parallelism, in both lowerings. Scatterable leaves follow
     zero/partition.py's rule (first dim >= dp and divisible); the rest are
-    replicated and all-reduce in either mode (they are the small tail)."""
-    import jax
-    from .topology import DP_AXIS  # noqa: F401  (doc anchor)
-    from ..runtime.zero.partition import _leaf_spec
+    replicated and all-reduce in either mode (they are the small tail).
 
+    ``zero3=True`` adds the stage-3 parameter-gather term: each sharded
+    param crosses the wire twice more per micro-step — the forward
+    all-gather and the backward re-gather (``jax.checkpoint`` around the
+    gather / the layer scan's manual VJP re-gathers instead of saving
+    the gathered tree) — at the COMPUTE dtype (``param_bytes_per_el``;
+    the fp32 master shard is cast in flight, zero/stage3.gather_cast),
+    each priced (g-1)/g · B by the ring model. With grad accumulation
+    every micro-step repeats the whole schedule (the explicit path
+    scatters into the sharded carry per micro-step too), the classic
+    ZeRO-3 3x pattern: total = gas · (2 gathers + 1 fp32 grad
+    reduce-scatter). ``param_specs`` overrides the sharded/replicated
+    split with
+    the engine's actual stage-3 spec tree (covered scanned leaves avoid
+    the layer axis, so their divisibility differs from the plain rule);
+    pass ``mesh`` with it so a dp+TP leaf is priced at its per-TP-rank
+    slice (the dp collective moves 1/mp of the leaf per rank, and the
+    dp gather reconstructs 1/mp per device, not the full leaf).
+    """
+    import jax
+    from .topology import DP_AXIS
+    from ..runtime.zero.partition import _leaf_spec, spec_dp_dim
+
+    leaves = jax.tree_util.tree_leaves(params)
+    if param_specs is not None:
+        spec_leaves = jax.tree_util.tree_structure(params).flatten_up_to(
+            param_specs)
+    else:
+        spec_leaves = [None] * len(leaves)
     scatterable = replicated = 0
-    for leaf in jax.tree_util.tree_leaves(params):
+    scatterable_el = 0
+    for leaf, sp in zip(leaves, spec_leaves):
         shape = getattr(leaf, "shape", None)
         if shape is None or getattr(leaf, "ndim", 0) < 1:
             continue
         nbytes = int(grad_bytes_per_el)
+        nel = 1
         for s in shape:
             nbytes *= int(s)
-        if any(e is not None for e in _leaf_spec(shape, dp, "data")):
+            nel *= int(s)
+        if sp is not None and mesh is not None:
+            # dp+TP leaf: the dp collectives carry this TP rank's slice.
+            for entry in sp:
+                for ax in ((entry,) if isinstance(entry, str)
+                           else (entry or ())):
+                    if ax != DP_AXIS:
+                        div = max(1, int(mesh.shape.get(ax, 1)))
+                        nbytes //= div
+                        nel //= div
+        # The DP axis specifically: a leaf sharded only over a TP/model
+        # axis never dp-scatters or dp-gathers (its dp grad sync is the
+        # replicated all-reduce).
+        sharded = spec_dp_dim(sp, DP_AXIS) is not None \
+            if sp is not None \
+            else any(e is not None for e in _leaf_spec(shape, dp, "data"))
+        if sharded:
             scatterable += nbytes
+            scatterable_el += nel
         else:
             replicated += nbytes
     repl_wire = ring_wire_bytes("all-reduce", replicated, dp)
-    return {
+    out = {
         "dp": dp,
         "grad_bytes": scatterable + replicated,
         "scatterable_bytes": scatterable,
@@ -338,3 +387,18 @@ def grad_sync_wire_model(params: Any, dp: int,
         "all_reduce_wire_bytes":
             ring_wire_bytes("all-reduce", scatterable, dp) + repl_wire,
     }
+    if zero3:
+        pbytes = int(param_bytes_per_el or grad_bytes_per_el)
+        gather_payload = scatterable_el * pbytes
+        one_gather = ring_wire_bytes("all-gather", gather_payload, dp)
+        out.update({
+            "param_gather_payload_bytes": gather_payload,
+            "param_gather_wire_bytes": 2 * int(gas) * one_gather,
+            "param_gathers_per_step": 2 * int(gas),
+            # Per STEP on the explicit path: every micro-step re-gathers
+            # (fwd + bwd) and scatters its grads into the sharded carry.
+            "zero3_wire_bytes":
+                int(gas) * (out["reduce_scatter_wire_bytes"]
+                            + 2 * one_gather),
+        })
+    return out

@@ -21,6 +21,14 @@ and there ``overlap_comm`` is load-bearing: it selects the bucketed
 overlapped offload pipeline (D2H / host Adam / H2D streamed per
 ``offload_bucket_size`` bucket through an ``offload_host_threads`` worker
 pool) over the serial fetch-step-upload path.
+
+Stage 3 (``stage: 3``) shards the PARAMETER tree itself over dp in
+addition to grads and optimizer state; ``prefetch_depth`` controls how
+many layers ahead the per-layer param all-gather is issued inside the
+model's layer scan (0 = gather at use, the parity baseline; see
+runtime/zero/stage3.py). Stage 3 requires ``reduce_scatter: true`` —
+the update is shard-local so the grads must come back as the owning
+shard.
 """
 from __future__ import annotations
 
@@ -36,6 +44,7 @@ class ZeroConfig:
         self.contiguous_gradients = C.ZERO_CONTIGUOUS_GRADIENTS_DEFAULT
         self.reduce_scatter = C.ZERO_REDUCE_SCATTER_DEFAULT
         self.grad_sync = C.ZERO_GRAD_SYNC_DEFAULT
+        self.prefetch_depth = C.ZERO_PREFETCH_DEPTH_DEFAULT
         self.reduce_bucket_size = C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT
         self.allgather_partitions = C.ZERO_ALLGATHER_PARTITIONS_DEFAULT
         self.allgather_bucket_size = C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT
@@ -74,6 +83,14 @@ class ZeroConfig:
                 f"{C.ZERO_GRAD_SYNC}='explicit' requires "
                 f"{C.ZERO_REDUCE_SCATTER}: true — reduce_scatter: false "
                 "selects the dense all-reduce gradient path")
+        self.prefetch_depth = get(d, C.ZERO_PREFETCH_DEPTH,
+                                  C.ZERO_PREFETCH_DEPTH_DEFAULT)
+        if not isinstance(self.prefetch_depth, int) \
+                or self.prefetch_depth < 0:
+            raise ValueError(
+                f"{C.ZERO_PREFETCH_DEPTH} must be a non-negative int "
+                f"(layers gathered ahead of use), got "
+                f"{self.prefetch_depth!r}")
         self.overlap_comm = get(d, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT)
         self.allgather_partitions = get(d, C.ZERO_ALLGATHER_PARTITIONS,
                                         C.ZERO_ALLGATHER_PARTITIONS_DEFAULT)
@@ -103,6 +120,14 @@ class ZeroConfig:
         if not isinstance(self.stage, int) or not (0 <= self.stage <= C.MAX_STAGE_ZERO_OPTIMIZATION):
             raise ValueError(
                 f"ZeRO stage must be an int in [0, {C.MAX_STAGE_ZERO_OPTIMIZATION}], got {self.stage}")
+        if self.stage >= 3 and not self.reduce_scatter:
+            # Stage 3 has no dense-gradient mode: the optimizer update is
+            # shard-local over dp-sharded params, so the grads MUST come
+            # back as the owning shard (reduce-scatter), never replicated.
+            raise ValueError(
+                f"{C.ZERO_REDUCE_SCATTER}: false does not compose with "
+                "ZeRO stage 3 — sharded parameters require the gradient "
+                "reduce-scattered back to the owning shard")
 
     def repr_dict(self) -> Dict[str, Any]:
         return dict(self.__dict__)

@@ -1,0 +1,390 @@
+"""ZeRO stage 3: parameter partitioning with prefetch-overlapped gathers.
+
+The reference hard-stops at stage 2 (engine.py:707-708 raises for any
+other stage); this module is the TPU-native stage 3. Parameters are
+*born* dp-sharded (``partition.stage3_param_specs`` — the same
+first-divisible-dim rule grads and moments follow, so the optimizer
+apply stays shard-local with no resharding), gathered just-in-time for
+use, and dropped right after their forward/backward consumption, with
+the gradient reduce-scattered back to the owning shard. Per step each
+parameter crosses the wire three times — fwd gather, bwd re-gather,
+grad reduce-scatter — the classic ZeRO-3 3x schedule (Rajbhandari et
+al., 2020 §5), priced by ``hlo_audit.grad_sync_wire_model(zero3=...)``.
+
+Two gather lowerings mirror the engine's ``grad_sync`` honesty split:
+
+- **declarative**: params carry dp ``NamedSharding``s into the jitted
+  step and GSPMD inserts the all-gathers at each use point (inside the
+  model's layer scan the use point is the per-layer slice, so gathers
+  land in the loop body); XLA's collective pipeliner owns the
+  compute/gather overlap. Correct wherever the partitioner is honest.
+- **explicit**: on backends whose partitioner regresses declarations
+  (this repo's CPU dev backend), the engine computes grads under
+  ``shard_map`` over dp and this module's ``gather_cast`` performs the
+  gather by construction: the fp32 master shard is cast to the compute
+  dtype and ``lax.all_gather``-ed (compute-dtype wire — half the bytes
+  of an fp32 gather under fp16/bf16), and its custom transpose
+  reduce-scatters the cotangent in fp32 — the same widen-then-scatter
+  the explicit ZeRO-2 path performs, so one stage-3 step is
+  BIT-identical to the stage-2 step from the same state.
+
+``zero3_block_scan`` is the rebuilt fwd/bwd layer scan for
+stacked-layer models (models/transformer.apply_blocks): a manual-VJP
+scan whose forward gathers each layer's shard ``prefetch_depth`` layers
+ahead of use (the gather for layer i+k is issued before layer i's
+compute, so it overlaps), and whose backward walks the layers in
+reverse with the same prefetch window, re-gathering each layer,
+recomputing its forward (full per-layer remat — the usual ZeRO-3/FSDP
+pairing), and reduce-scattering its grads inside the scan. Because the
+VJP is manual, the gathered weights are NEVER saved as residuals at any
+prefetch depth — the live gather working set is bounded at
+``prefetch_depth + 1`` layers (``gather_working_set_bytes``), which the
+lint materialization gate checks (analysis/passes.py reads
+``zero3_gather_bytes`` from the engine's path meta).
+
+``prefetch_depth: 0`` gathers at use — no overlap structure, the
+parity baseline ``ablate_zero3_prefetch.py`` measures against.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["gather_cast", "gather_tree", "Zero3Scan", "zero3_block_scan",
+           "gather_working_set_bytes"]
+
+
+# --------------------------------------------------------------------- #
+# The explicit gather: compute-dtype all-gather, fp32 scatter transpose
+# --------------------------------------------------------------------- #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def gather_cast(x, axis_name: str, dim: Optional[int], dtype):
+    """``all_gather(x.astype(dtype), axis_name, axis=dim, tiled=True)``
+    with a custom transpose that widens the cotangent to fp32 BEFORE the
+    reduce-scatter and returns the fp32 owning shard.
+
+    The primal input is the fp32 master shard (or a bf16 master-free
+    shard); the gather wire moves ``dtype`` bytes (the compute dtype),
+    and the gradient reduction runs in fp32 regardless — the exact
+    widen-then-scatter the explicit ZeRO-2 path performs, which is what
+    makes one stage-3 step bit-identical to stage 2. ``dim=None`` skips
+    the collective (a replicated leaf): cast only.
+    """
+    x = x.astype(dtype)
+    if dim is None:
+        return x
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _gather_cast_fwd(x, axis_name, dim, dtype):
+    # Residual: a 0-d dtype carrier (a raw np.dtype is not a JAX type).
+    return gather_cast(x, axis_name, dim, dtype), jnp.zeros((), x.dtype)
+
+
+def _gather_cast_bwd(axis_name, dim, dtype, res, ct):
+    ct = ct.astype(jnp.float32)
+    if dim is not None:
+        ct = lax.psum_scatter(ct, axis_name, scatter_dimension=dim,
+                              tiled=True)
+    else:
+        ct = lax.psum(ct, axis_name)
+    return (ct.astype(res.dtype),)
+
+
+gather_cast.defvjp(_gather_cast_fwd, _gather_cast_bwd)
+
+
+def gather_tree(tree: Any, dims: Any, axis_name: str, dtype) -> Any:
+    """Per-leaf ``gather_cast`` over a pytree of shards. ``dims`` is the
+    matching tree of dp partition dims (None = replicated leaf: cast +
+    psum-transpose only). Non-float leaves pass through untouched."""
+    def one(leaf, d):
+        if not hasattr(leaf, "dtype") or \
+                not jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf
+        return gather_cast(leaf, axis_name, d, dtype)
+    return jax.tree_util.tree_map(one, tree, dims)
+
+
+# --------------------------------------------------------------------- #
+# The engine <-> model contract for the per-layer prefetched scan
+# --------------------------------------------------------------------- #
+class Zero3Scan:
+    """Binds the model's stacked-layer scan to the engine's stage-3
+    layout. Build one, hand it to BOTH the loss builder (e.g.
+    ``gpt2_loss_fn(cfg, zero3=spec)``) and the engine
+    (``deepspeed_tpu.initialize(..., zero3_scan=spec)``); the engine
+    binds mode/mesh/dims at construction, the model reads them at trace
+    time (the first train step, which follows engine init).
+
+    ``scope``: substring of the param-tree path marking the leaves the
+    model gathers ITSELF per layer (default ``"blocks"`` — the
+    transformer's stacked subtree). Leaf names inside the scope must be
+    unique (the transformer block dict is). The engine's generic gather
+    skips covered leaves; ``partition.stage3_param_specs`` keeps their
+    layer axis (dim 0) unsharded so per-layer slices stay dp-sharded.
+    """
+
+    def __init__(self, prefetch_depth: Optional[int] = None,
+                 scope: str = "blocks"):
+        self.prefetch_depth = prefetch_depth   # None -> engine config
+        self.scope = scope
+        self.mode = "unbound"                  # explicit|declarative|unbound
+        self.mesh: Optional[Mesh] = None
+        self.axis_name: Optional[str] = None
+        self.compute_dtype = None
+        # name -> (gather dim AFTER the layer slice, gathered P after the
+        # slice) for covered leaves; gather dim None = replicated leaf.
+        self.layer_info: Dict[str, Tuple[Optional[int], P]] = {}
+
+    def covers(self, path_str: str) -> bool:
+        # Exact key-segment match, not substring: a leaf named
+        # "blocks_ln_scale" must NOT silently join the scan scope (a
+        # covered-but-unscanned leaf would skip the engine's gather AND
+        # the model's per-layer scatter — its grads would never reduce
+        # across dp).
+        return f"['{self.scope}']" in path_str
+
+    def bind(self, *, mode: str, mesh: Mesh, axis_name: str, compute_dtype,
+             prefetch_depth: int,
+             layer_info: Dict[str, Tuple[Optional[int], P]]) -> None:
+        self.mode = mode
+        self.mesh = mesh
+        self.axis_name = axis_name
+        self.compute_dtype = compute_dtype
+        if self.prefetch_depth is None:
+            self.prefetch_depth = int(prefetch_depth)
+        self.layer_info = dict(layer_info)
+
+    @property
+    def bound(self) -> bool:
+        return self.mode in ("explicit", "declarative")
+
+    # ---- per-layer gather (the sliced view: layer axis dropped) ---- #
+    def gather_layer(self, p_layer: Dict[str, Any]) -> Dict[str, Any]:
+        """Gather one layer's param dict to full (replicated-over-dp)
+        arrays in the compute dtype."""
+        out = {}
+        for name, leaf in p_layer.items():
+            gdim, gspec = self.layer_info.get(name, (None, P()))
+            if not hasattr(leaf, "dtype") or \
+                    not jnp.issubdtype(leaf.dtype, jnp.floating):
+                out[name] = leaf
+            elif self.mode == "explicit":
+                out[name] = gather_cast(leaf, self.axis_name, gdim,
+                                        self.compute_dtype)
+            else:   # declarative: constrain to the dp-free spec; GSPMD
+                    # lowers the all-gather at this use point.
+                out[name] = lax.with_sharding_constraint(
+                    leaf, NamedSharding(self.mesh, gspec))
+        return out
+
+
+# --------------------------------------------------------------------- #
+# The rebuilt fwd/bwd layer scan
+# --------------------------------------------------------------------- #
+def zero3_block_scan(block_fn: Callable, stacked: Dict[str, Any],
+                     x: Any, keys: Any, spec: Zero3Scan) -> Any:
+    """Run L stacked layers with per-layer just-in-time param gathers.
+
+    ``block_fn(layer_params_full, h, key) -> h`` is the single-layer
+    apply (already closed over cfg/mask/attention_fn). ``stacked`` is
+    the layer-stacked param dict — under the engine's explicit stage-3
+    path these arrive as the per-rank SHARDS (fp32 masters), under the
+    declarative path as dp-sharded global arrays.
+
+    Explicit mode is a manual-VJP scan (module docstring): forward
+    gathers layer i+prefetch_depth while layer i computes; backward
+    walks reversed with the same window, re-gathers, recomputes the
+    layer forward (full per-layer remat) and reduce-scatters each
+    layer's grads inside the scan. Residuals are the per-layer input
+    activations plus the shards — the gathered weights are never saved,
+    so the live gather working set is prefetch_depth + 1 layers.
+
+    Declarative mode gathers at use inside a rematted scan body (XLA's
+    collective pipeliner owns the overlap there — the structural
+    prefetch knob is an explicit-mode device).
+    """
+    if not spec.bound:
+        raise ValueError(
+            "zero3_block_scan needs a bound Zero3Scan (the engine binds "
+            "it at construction; build the engine before tracing the "
+            "loss, or bind the spec manually in tests)")
+    names = sorted(stacked.keys())
+    L = int(stacked[names[0]].shape[0])
+    depth = max(0, min(int(spec.prefetch_depth or 0), L - 1))
+
+    if spec.mode == "declarative":
+        def body(h, xs):
+            p_shard, key = xs
+
+            def blk(p_, h_):
+                return block_fn(spec.gather_layer(p_), h_, key)
+            # Remat: the gathered weights are re-gathered in backward
+            # instead of being saved stacked across the scan.
+            h = jax.checkpoint(blk)(p_shard, h)
+            return h, None
+        h, _ = lax.scan(body, x, (stacked, keys))
+        return h
+
+    # ---- explicit mode: manual-VJP prefetched fwd/bwd scan ---- #
+    axis = spec.axis_name
+
+    def gather_layer(p_layer):
+        return spec.gather_layer(p_layer)
+
+    def slice_layer(tree, i):
+        return {n: tree[n][i] for n in names}
+
+    def roll(tree, k):
+        if k == 0:
+            return tree
+        return {n: jnp.roll(tree[n], -k, axis=0) for n in names}
+
+    def scatter_grads(dp_full, p_layer_shard):
+        """fp32 reduce-scatter of one layer's full-grad dict back to the
+        owning shard (the gather_cast transpose, inlined)."""
+        out = {}
+        for n in names:
+            g = dp_full[n].astype(jnp.float32)
+            gdim, _ = spec.layer_info.get(n, (None, P()))
+            if gdim is None:
+                g = lax.psum(g, axis)
+            else:
+                g = lax.psum_scatter(g, axis, scatter_dimension=gdim,
+                                     tiled=True)
+            out[n] = g.astype(p_layer_shard[n].dtype)
+        return out
+
+    def prime_window(tree):
+        """The first ``depth`` layers gathered ahead of the scan."""
+        return tuple(gather_layer(slice_layer(tree, i))
+                     for i in range(depth))
+
+    @jax.custom_vjp
+    def run(shards, h, keys):
+        out, _ = _fwd(shards, h, keys)
+        return out
+
+    def _fwd(shards, h, keys):
+        if depth == 0:
+            def body(hh, xs):
+                p_shard, key = xs
+                h_out = block_fn(gather_layer(p_shard), hh, key)
+                return h_out, hh
+            hf, h_ins = lax.scan(body, h, (shards, keys))
+            return hf, h_ins
+
+        def body(carry, xs):
+            hh, window = carry
+            p_next_shard, key = xs
+            # Issue layer i+depth's gather FIRST: it has no data
+            # dependence on layer i's compute, so the scheduler overlaps
+            # them — the prefetch.
+            p_next = gather_layer(p_next_shard)
+            h_out = block_fn(window[0], hh, key)
+            return (h_out, window[1:] + (p_next,)), hh
+        # xs deliver layer i+depth at iteration i; the trailing wrap
+        # slices re-gather the first ``depth`` layers harmlessly —
+        # schedule overhead of 2·depth one-layer gathers per step (fwd +
+        # bwd) that the analytic wire model deliberately omits (it is
+        # depth/L of the covered gather wire; the audit's compiled-vs-
+        # model checks run on the unscanned program).
+        (hf, _), h_ins = lax.scan(body, (h, prime_window(shards)),
+                                  (roll(shards, depth), keys))
+        return hf, h_ins
+
+    def run_fwd(shards, h, keys):
+        out, h_ins = _fwd(shards, h, keys)
+        # Residuals: the SHARDS (already 1/dp), per-layer input
+        # activations, and the keys — never a gathered layer.
+        return out, (shards, h_ins, keys)
+
+    def run_bwd(res, dh):
+        shards, h_ins, keys = res
+        rev = {n: shards[n][::-1] for n in names}
+        rev_h = jax.tree_util.tree_map(lambda a: a[::-1], h_ins)
+        rev_k = keys[::-1]
+
+        def layer_vjp(p_full, hin, key, dhh):
+            _, vjp = jax.vjp(lambda p, hh: block_fn(p, hh, key),
+                             p_full, hin)
+            return vjp(dhh)
+
+        if depth == 0:
+            def body(dhh, xs):
+                p_shard, hin, key = xs
+                p_full = gather_layer(p_shard)
+                dp_full, dhh = layer_vjp(p_full, hin, key, dhh)
+                return dhh, scatter_grads(dp_full, p_shard)
+            dh0, dps = lax.scan(body, dh, (rev, rev_h, rev_k))
+        else:
+            def body(carry, xs):
+                dhh, window = carry
+                p_next_shard, p_cur_shard, hin, key = xs
+                p_next = gather_layer(p_next_shard)   # prefetch (reverse)
+                dp_full, dhh = layer_vjp(window[0], hin, key, dhh)
+                dps = scatter_grads(dp_full, p_cur_shard)
+                return (dhh, window[1:] + (p_next,)), dps
+            (dh0, _), dps = lax.scan(
+                body, (dh, prime_window(rev)),
+                (roll(rev, depth), rev, rev_h, rev_k))
+        dshards = {n: dps[n][::-1] for n in names}
+        return dshards, dh0, None
+
+    run.defvjp(run_fwd, run_bwd)
+    return run(stacked, x, keys)
+
+
+# --------------------------------------------------------------------- #
+# Analytic memory: the bounded gather working set
+# --------------------------------------------------------------------- #
+def gather_working_set_bytes(params: Any, specs: Any, axis_name: str,
+                             compute_itemsize: int,
+                             prefetch_depth: int = 0,
+                             scan_paths: Optional[Callable] = None,
+                             mesh: Optional[Mesh] = None) -> int:
+    """Per-device bytes of gathered (compute-dtype) parameters live at
+    once under stage 3.
+
+    Only leaves sharded on the DP axis gather (a TP-only leaf never
+    crosses the dp wire — counting it would loosen the materialization
+    gate by the whole TP-sharded portion). Leaves the model gathers per
+    layer (``scan_paths``) contribute ``(prefetch_depth + 1)`` layer
+    slices; everything else is gathered leaf-at-use and contributes its
+    dp-gathered size — still divided by any OTHER mesh axes on the leaf
+    (pass ``mesh``; a dp+TP leaf gathers to 1/mp per device, not full).
+    This is the term the engine adds to the analytic state footprint
+    for the memory watermark and the lint materialization gate —
+    "declared per-device state plus a bounded gather working set, never
+    the full parameter tree at fp32 master width".
+    """
+    from .partition import spec_dp_dim
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    spec_leaves = treedef.flatten_up_to(specs)
+    scanned_layer_bytes = 0
+    generic_bytes = 0
+    for (path, leaf), sp in zip(flat, spec_leaves):
+        shape = getattr(leaf, "shape", None)
+        if shape is None or spec_dp_dim(sp, axis_name) is None:
+            continue    # replicated or TP-only: no dp gather
+        n = int(compute_itemsize)
+        for d in shape:
+            n *= int(d)
+        if mesh is not None:
+            for entry in sp:
+                for ax in ((entry,) if isinstance(entry, str)
+                           else (entry or ())):
+                    if ax != axis_name:
+                        n //= max(1, int(mesh.shape.get(ax, 1)))
+        if scan_paths is not None and \
+                scan_paths(jax.tree_util.keystr(path)):
+            scanned_layer_bytes += n // max(1, int(shape[0]))
+        else:
+            generic_bytes += n
+    return generic_bytes + (int(prefetch_depth) + 1) * scanned_layer_bytes

@@ -393,7 +393,8 @@ class TestBenchGate:
     def test_extract_metrics_all_shapes(self):
         bg = load_bench_gate()
         none_srv = {"serve_tps": None, "ttft_p95": None,
-                    "kernel_speedup": None, "health": None}
+                    "kernel_speedup": None, "zero3_overlap": None,
+                    "health": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}

@@ -160,9 +160,18 @@ class TestZero:
         assert any("data" in str(s.spec) for s in shardings
                    if hasattr(s, "spec")), shardings
 
-    def test_stage3_rejected(self):
-        with pytest.raises(NotImplementedError):
-            make_engine(base_config(zero_optimization={"stage": 3}))
+    def test_stage3_accepted_params_sharded(self):
+        # The reference raises for stage > 2 (engine.py:707-708); since
+        # ISSUE 11 stage 3 shards the param tree itself (full coverage
+        # in tests/test_zero3.py). Stage 4 stays rejected.
+        engine = make_engine(base_config(zero_optimization={"stage": 3}))
+        shardings = jax.tree_util.tree_leaves(
+            jax.tree_util.tree_map(lambda x: x.sharding,
+                                   engine.state.params))
+        assert any("data" in str(s.spec) for s in shardings
+                   if hasattr(s, "spec")), shardings
+        with pytest.raises(Exception):
+            make_engine(base_config(zero_optimization={"stage": 4}))
 
 
 class TestOptimizers:

@@ -30,6 +30,11 @@ MATRIX = [
     ("Lamb", "fp32", 1, False),
     ("SGD", "bf16", 0, False),
     ("OneBitAdam", "bf16", 0, False),
+    # ZeRO-3 (params born dp-sharded, gathered at use — ISSUE 11)
+    ("Adam", "fp32", 3, False),
+    ("Adam", "fp16", 3, False),
+    ("AdamW", "bf16", 3, False),
+    ("Adam", "bf16", 3, True),
 ]
 
 

@@ -371,8 +371,8 @@ class TestOnebitWire:
         assert os.path.exists(path), "run tools/run_comm_audit.sh"
         rec = json.load(open(path))
         assert rec["all_pass"] is True
-        for name in ("zero1", "zero2", "onebit", "pipeline_1f1b",
-                     "ring_attention"):
+        for name in ("zero1", "zero2", "zero3", "onebit",
+                     "pipeline_1f1b", "ring_attention"):
             assert rec["configs"][name]["pass"] is True, name
         # ISSUE-8 satellite: the fused-chunk-gather finding is RESOLVED
         # (shard-local V-interleaved layout) — the recorded artifact must

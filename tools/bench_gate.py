@@ -28,7 +28,9 @@ tokens/s regresses on a relative drop beyond ``--serve-drop`` (default
 step changes, not jitter); the fused-kernel ablation speedup (the
 ``kernels.fused_speedup`` field a DS_BENCH_KERNELS=1 bench or
 ``ablate_fused_ln.py`` records) regresses on a relative drop beyond
-``--kernel-drop`` (default 10%). A TELEMETRY.json carrying a ``health``
+``--kernel-drop`` (default 10%); the ZeRO-3 prefetch overlap fraction
+(``zero3.overlap_fraction`` from ablate_zero3_prefetch.py's
+ZERO3_BENCH.json) regresses on the same relative threshold. A TELEMETRY.json carrying a ``health``
 section is additionally validated on the NEW side alone: UNSKIPPED
 non-finite anomalies (overflow-skipped steps are routine fp16
 loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
@@ -68,6 +70,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     serve_tps: Optional[float] = None
     ttft_p95: Optional[float] = None
     kernel_speedup: Optional[float] = None
+    zero3_overlap: Optional[float] = None
+    # ZERO3_BENCH.json (ablate_zero3_prefetch.py): the analytic fraction
+    # of the per-layer gather the depth-1 prefetch hides.
+    z3 = doc.get("zero3")
+    if isinstance(z3, dict) and z3.get("overlap_fraction") is not None:
+        zero3_overlap = float(z3["overlap_fraction"])
     # DS_BENCH_KERNELS ablation record: the fused-over-unfused step
     # speedup (bench.py bench_kernels_ablation / ablate_fused_ln.py).
     krn = doc.get("kernels")
@@ -111,7 +119,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         }
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
-            "health": health}
+            "zero3_overlap": zero3_overlap, "health": health}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -218,6 +226,24 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         missing = [n for n, m in ((name_old, old), (name_new, new))
                    if m["kernel_speedup"] is None]
         print(f"kernel fused speedup: skipped (no kernels record in "
+              f"{', '.join(missing)})")
+
+    if old["zero3_overlap"] is not None and \
+            new["zero3_overlap"] is not None:
+        compared += 1
+        floor = old["zero3_overlap"] * (1.0 - kernel_drop)
+        verdict = "OK" if new["zero3_overlap"] >= floor else "REGRESSION"
+        print(f"zero3 prefetch overlap: {name_old}="
+              f"{old['zero3_overlap']:.4g} -> "
+              f"{name_new}={new['zero3_overlap']:.4g} "
+              f"(floor {floor:.4g}, -{kernel_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-ZeRO-3 rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["zero3_overlap"] is None]
+        print(f"zero3 prefetch overlap: skipped (no zero3 record in "
               f"{', '.join(missing)})")
 
     # Health validation: NEW side only (defects, not diffs). Pre-health

@@ -14,6 +14,11 @@ of the repo's central scaling claims:
   unpartitioned). Both the declarative (GSPMD) and explicit
   (lax.psum_scatter) lowerings are audited; the engine's grad_sync=auto
   picks whichever is honest on this backend.
+- **zero3**: PARAMS born dp-sharded — per step the sharded params
+  all-gather for fwd + re-gather for bwd ((n-1)/n · B each, compute-
+  dtype wire) and grads reduce-scatter back to the owning shard; the
+  layer-scan program keeps its per-layer gathers inside the scan loop
+  (prefetched one layer ahead), never a stacked-tensor-sized gather.
 - **onebit**: the in-XLA emulation psums full-precision tensors (recorded
   as such); the DCN wire format is packed sign bits + per-chunk scales,
   ~1/32 of dense (ops/onebit.comm_bytes).
@@ -173,6 +178,100 @@ def audit_zero2():
         "checks": checks, "pass": all(checks.values()),
     })
     return out
+
+
+def audit_zero3():
+    """Stage 3: params born dp-sharded; per step every sharded param is
+    all-gathered for forward, re-gathered for backward (the remat
+    schedule — XLA may CSE the pair into one buffer held across
+    fwd/bwd, trading the wire back for memory; both are counted
+    honestly), and its grad reduce-scattered back to the owning shard.
+    Checks: per-gather wire within 5% of the (g-1)/g ring model, grads
+    lower to reduce-scatter (never a grad-sized all-reduce), and the
+    layer-scan program keeps its per-layer gathers INSIDE the scan loop
+    with no stacked-tensor-sized gather anywhere."""
+    e = _engine({"zero_optimization": {"stage": 3}})
+    audit = _audit_train_step(e)
+    model = hlo_audit.grad_sync_wire_model(
+        jax.device_get(e.state.params), e.dp_size, zero3=True,
+        param_bytes_per_el=4, gas=1, param_specs=e._stage3_specs)
+    ag = audit.of_kind("all-gather")
+    rs = audit.of_kind("reduce-scatter")
+    ag_payload = sum(o.payload_bytes for o in ag)
+    ag_wire = sum(o.wire_bytes for o in ag)
+    one_gather = hlo_audit.ring_wire_bytes(
+        "all-gather", model["param_gather_payload_bytes"], e.dp_size)
+    # Compiled gathers per step: 2 per the declared schedule, 1 when XLA
+    # CSEs the remat pair (this backend does).
+    gathers = round(ag_payload / max(1, model["param_gather_payload_bytes"]))
+    rs_payload = sum(o.payload_bytes for o in rs)
+    biggest_leaf = max(
+        int(np.prod(l.shape)) * 4 for l in
+        jax.tree_util.tree_leaves(jax.device_get(e.state.params)))
+    grad_ar = [o for o in audit.of_kind("all-reduce")
+               if o.payload_bytes >= biggest_leaf]
+    checks = {
+        "params_born_sharded": "data" in str(
+            e.state.params["w1"].sharding.spec),
+        "grad_sync_reduce_scattered":
+            rs_payload == model["scatterable_bytes"],
+        "no_grad_sized_allreduce": not grad_ar,
+        "gather_wire_within_5pct_of_model":
+            gathers >= 1 and
+            abs(ag_wire - gathers * one_gather) <= 0.05 * ag_wire,
+    }
+
+    # The stacked-layer model: gathers must sit INSIDE the scan body
+    # (one layer at a time, prefetched), never a full stacked tensor.
+    import dataclasses
+    from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,
+                                           gpt2_loss_fn)
+    from deepspeed_tpu.runtime.zero.stage3 import Zero3Scan
+    cfg = dataclasses.replace(
+        GPT2_CONFIGS["gpt2-tiny"], num_layers=4, dtype=jnp.float32,
+        hidden_dropout=0.0, attn_dropout=0.0, fused_kernels=False)
+    spec = Zero3Scan()
+    gp = gpt2_init(jax.random.PRNGKey(0), cfg)
+    ge, *_ = deepspeed_tpu.initialize(
+        model=gpt2_loss_fn(cfg, zero3=spec), model_params=gp,
+        config={"train_batch_size": 16, "gradient_accumulation_steps": 1,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 3, "prefetch_depth": 1},
+                "steps_per_print": 10 ** 9},
+        zero3_scan=spec)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size,
+                          size=(16, 33)).astype(np.int32)
+    mb = ge._stack_micro_batches(tokens)
+    mb = jax.device_put(mb, ge._batch_sharding(mb, leading_dims=2))
+    gaudit = hlo_audit.audit_jit(ge._build_train_step(), ge.state, mb,
+                                 ge._base_rng)
+    gag = gaudit.of_kind("all-gather")
+    stacked_full = {n: int(np.prod(l.shape)) * 4
+                    for n, l in gp["blocks"].items()}
+    biggest_stacked = max(stacked_full.values())
+    scan_checks = {
+        "layer_gathers_inside_scan":
+            any(o.in_loop for o in gag),
+        "no_stacked_tensor_gather":
+            all(o.payload_bytes < biggest_stacked for o in gag),
+        "grads_reduce_scattered_in_scan":
+            any(o.in_loop for o in gaudit.of_kind("reduce-scatter")),
+    }
+    checks.update({f"scan_{k}": v for k, v in scan_checks.items()})
+    return {
+        "config": {"stage": 3, "dp": e.dp_size,
+                   "grad_sync": e._grad_sync_mode,
+                   "prefetch_depth": ge._prefetch_depth},
+        "hlo": audit.summary(),
+        "model": model,
+        "compiled_gather_wire_bytes": ag_wire,
+        "compiled_gathers_per_step": gathers,
+        "declared_gathers_per_step": model["param_gathers_per_step"],
+        "layer_scan_hlo": gaudit.summary(),
+        "layer_scan_in_loop_gathers": len([o for o in gag if o.in_loop]),
+        "checks": checks, "pass": all(checks.values()),
+    }
 
 
 def audit_onebit():
@@ -365,6 +464,7 @@ def main():
         "configs": {},
     }
     for name, fn in [("zero1", audit_zero1), ("zero2", audit_zero2),
+                     ("zero3", audit_zero3),
                      ("onebit", audit_onebit),
                      ("pipeline_1f1b", audit_1f1b),
                      ("ring_attention", audit_ring_attention)]:

@@ -14,6 +14,9 @@ Flagships (the engine modes whose compiled programs differ):
 
 - **zero1**   — stage 1, fused Adam (sharded moments, replicated grads)
 - **zero2**   — stage 2, grad_sync auto (explicit reduce-scatter here)
+- **zero3**   — stage 3, fp16: params born dp-sharded, the prefetched
+  per-layer gather scan on gpt2-tiny; materialization gates declared
+  state + bounded gather working set, never the full fp32 master tree
 - **onebit**  — 1-bit Adam compression step (stage 0 shard_map psums)
 - **offload** — ZeRO-Offload bucketed grad pass (host Adam)
 - **pipeline_1f1b** — compiled pp=2 interleaved pipeline ticks
@@ -125,6 +128,38 @@ def build_zero2():
     return _engine("zero2", {"zero_optimization": {"stage": 2}}, gas=2)
 
 
+def build_zero3():
+    # Stage 3 on the stacked-layer model with the prefetched layer scan:
+    # params born dp-sharded, per-layer gathers inside the scan, grads
+    # reduce-scattered — the materialization pass gates that no compiled
+    # path holds more than declared state + the bounded gather working
+    # set (never the fp32 master tree). fp16 exercises the in-flight
+    # master-shard -> compute-dtype cast on the gather.
+    import dataclasses
+    from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,
+                                           gpt2_loss_fn)
+    from deepspeed_tpu.runtime.zero.stage3 import Zero3Scan
+
+    cfg = dataclasses.replace(GPT2_CONFIGS["gpt2-tiny"], num_layers=4,
+                              dtype=jnp.float16, hidden_dropout=0.0,
+                              attn_dropout=0.0, fused_kernels=False)
+    spec = Zero3Scan()
+    params = gpt2_init(jax.random.PRNGKey(0), cfg)
+    ds_cfg = {"train_batch_size": 16, "gradient_accumulation_steps": 1,
+              "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+              "zero_optimization": {"stage": 3, "prefetch_depth": 1},
+              "fp16": {"enabled": True},
+              "steps_per_print": 10 ** 9, "telemetry": _tel("zero3")}
+    engine, *_ = deepspeed_tpu.initialize(
+        model=gpt2_loss_fn(cfg, zero3=spec), model_params=params,
+        config=ds_cfg, zero3_scan=spec)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(16, 33)).astype(np.int32)
+    for _ in range(2):
+        engine.train_batch(batch=tokens)
+    return engine
+
+
 def build_onebit():
     return _engine("onebit", {}, optimizer={
         "type": "OneBitAdam", "params": {"lr": 1e-3, "freeze_step": 2}})
@@ -191,6 +226,7 @@ def build_serving():
 FLAGSHIPS = {
     "zero1": build_zero1,
     "zero2": build_zero2,
+    "zero3": build_zero3,
     "onebit": build_onebit,
     "offload": build_offload,
     "pipeline_1f1b": build_pipeline_1f1b,
