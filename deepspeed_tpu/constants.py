@@ -295,6 +295,50 @@ INFERENCE_QUANTIZE_MODES = ("none", "bf16", "int8")
 # has a sequence axis.
 INFERENCE_PREFILL_CHUNK = "prefill_chunk"
 INFERENCE_PREFILL_CHUNK_DEFAULT = 32
+# Paged KV cache (the PagedAttention design): the cache is a pool of
+# fixed-size blocks and a slot holds a list of block ids, so short and
+# long requests share HBM and common prompt prefixes are shared
+# copy-on-write across requests (full-block granularity, chain-hashed).
+# block_size is the tokens-per-block page size; 0 = the PR-7 slot-major
+# layout (one max_seq_len row per slot, no sharing). Must divide
+# max_seq_len.
+INFERENCE_BLOCK_SIZE = "block_size"
+INFERENCE_BLOCK_SIZE_DEFAULT = 16
+# Total blocks in the pool; 0 = full provisioning (max_slots *
+# max_seq_len / block_size — every slot can reach max_seq_len, so
+# admission never blocks on HBM). Smaller pools oversubscribe: the
+# scheduler's admission gate then accounts free blocks, and the HBM
+# saved is what SERVE_BENCH.json's hbm_bytes_per_token measures. Must
+# be divisible by the mesh dp-axis size (blocks are born sharded over
+# dp alongside the slots they serve).
+INFERENCE_NUM_BLOCKS = "num_blocks"
+INFERENCE_NUM_BLOCKS_DEFAULT = 0
+# Speculative decoding (draft-then-verify, Leviathan et al. 2023):
+# spec_k > 0 proposes k tokens per live slot from the self-drafting
+# n-gram cache (prompt-lookup decoding — no drafter model) and one
+# batched verify step accepts the longest agreeing prefix plus one
+# corrected token. Greedy output is bit-identical to non-speculative
+# greedy decode; the scheduler falls back to plain decode when
+# temperature > 0 (exact rejection sampling is not implemented).
+# Requires the paged cache (block_size > 0).
+INFERENCE_SPEC_K = "spec_k"
+INFERENCE_SPEC_K_DEFAULT = 0
+# n-gram context length the drafter matches against the slot's token
+# history (it tries n, n-1, ..., 1 and proposes the continuation of the
+# most recent prior occurrence; repeat-last-token when nothing matches).
+INFERENCE_SPEC_NGRAM = "spec_ngram"
+INFERENCE_SPEC_NGRAM_DEFAULT = 3
+# KV-pool storage dtype: "model" stores blocks at the model compute
+# dtype; "bf16" halves fp32 KV HBM at rest (scores are fp32 either way).
+INFERENCE_KV_DTYPE = "kv_cache_dtype"
+INFERENCE_KV_DTYPE_DEFAULT = "model"
+INFERENCE_KV_DTYPE_MODES = ("model", "bf16")
+# Replica label stamped on this engine's telemetry + aggregator
+# snapshots ("" = unlabeled single replica). The multi-replica router
+# (inference/router.py) sets it so telemetry_report can keep replicas'
+# percentile streams apart.
+INFERENCE_REPLICA = "replica"
+INFERENCE_REPLICA_DEFAULT = ""
 
 #############################################
 # ZeRO

@@ -1,25 +1,37 @@
 """inference/ — the batched autoregressive serving tier.
 
 The training side of this framework ends at a checkpoint; this package
-is what stands between that checkpoint and heavy traffic: a slot-major
-KV cache born sharded over the training mesh (kv_cache.py), jitted
-single-token decode + chunked/whole-prompt prefill over the GPT-2 family
-(decode.py), iteration-level continuous batching with an open-loop
-request queue (scheduler.py), weight quantization via the stochastic-
-rounding machinery (quantize.py), and the InferenceEngine tying it to
-the telemetry spine — decode-step JSONL records, prefill spans, the
-recompile sentinel over both compiled paths, and per-request
-TTFT/TPOT/occupancy goodput (engine.py). See
+is what stands between that checkpoint and heavy traffic: a paged,
+prefix-shared KV cache born sharded over the training mesh — fixed-size
+blocks behind a block-table indirection, copy-on-write prefix sharing,
+reservation-gated admission (kv_cache.py) — jitted single-token decode,
+chunked/whole-prompt prefill, and the speculative draft-then-verify
+step over the GPT-2 family (decode.py), the self-drafting n-gram
+proposer (spec.py), iteration-level continuous batching with an
+open-loop request queue (scheduler.py), weight quantization via the
+stochastic-rounding machinery (quantize.py), the InferenceEngine tying
+it to the telemetry spine — decode-step JSONL records, prefill spans,
+the recompile sentinel over every compiled path, per-request
+TTFT/TPOT/occupancy goodput plus HBM-bytes-per-token, prefix-hit and
+spec-acceptance accounting (engine.py) — and the prefix-affinity
+multi-replica admission router (router.py). See
 docs/tutorials/inference.md.
 """
 from .engine import InferenceEngine
-from .kv_cache import KVCacheSpec, cache_partition_spec, init_cache
+from .kv_cache import (BlockAllocator, KVCacheSpec, PagedKVCacheSpec,
+                       PoolExhausted, cache_partition_spec, init_cache,
+                       init_paged_cache, paged_partition_spec)
 from .quantize import dequantize, quantize_params
+from .router import ReplicaRouter
 from .scheduler import (ContinuousBatchingScheduler, Request,
-                        synthetic_requests)
+                        shared_prefix_requests, synthetic_requests)
+from .spec import NGramDrafter
 
 __all__ = [
-    "InferenceEngine", "KVCacheSpec", "cache_partition_spec",
-    "init_cache", "quantize_params", "dequantize",
-    "Request", "synthetic_requests", "ContinuousBatchingScheduler",
+    "InferenceEngine", "KVCacheSpec", "PagedKVCacheSpec",
+    "BlockAllocator", "PoolExhausted", "cache_partition_spec",
+    "paged_partition_spec", "init_cache", "init_paged_cache",
+    "quantize_params", "dequantize", "Request", "synthetic_requests",
+    "shared_prefix_requests", "ContinuousBatchingScheduler",
+    "ReplicaRouter", "NGramDrafter",
 ]

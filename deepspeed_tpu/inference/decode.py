@@ -1,7 +1,18 @@
-"""Incremental GPT-2 forward paths: single-token decode and chunked /
-whole-prompt prefill against the slot-major KV cache.
+"""Incremental GPT-2 forward paths: single-token decode, chunked /
+whole-prompt prefill, and the speculative verify step — against the
+paged block pool (the production layout) or the slot-major cache (the
+PR-7 parity baseline).
 
-Three compiled programs make up the serving data plane, each with a
+The PAGED programs (``gpt2_decode_paged`` / ``gpt2_verify_paged`` /
+``gpt2_prefill_chunk_paged`` / ``gpt2_prefill_full_paged``) route every
+cache access through the block-table one-hot primitives in
+``inference/kv_cache.py``: group-batched over the mesh data axis, one
+compiled shape whatever the tables hold, no full-pool gather. The
+verify step generalizes decode to K tokens per slot and, with
+``spec_accept``, implements draft-then-verify speculative decoding
+whose greedy output is bit-identical to single-token decode.
+
+The SLOT-MAJOR programs below make up the PR-7 data plane, each with a
 FIXED abstract signature (the recompile sentinel wraps all of them):
 
 - ``gpt2_decode``: one token per slot, for every slot at once. Attends
@@ -233,6 +244,232 @@ def gpt2_prefill_full(params: Dict[str, Any], kc: jax.Array,
     return logits, kc, vc
 
 
+# ===================================================================== #
+# Paged paths: decode / chunked prefill / speculative verify through the
+# block-table indirection (inference/kv_cache.py paged primitives).
+# Everything is group-batched over the mesh data axis; ONE compiled
+# shape each, whatever the block tables hold.
+# ===================================================================== #
+def _group_shape(arr: jax.Array, num_groups: int) -> jax.Array:
+    """[S, ...] → [G, S/G, ...]: split the slot axis into (group,
+    slot-in-group) — a local reshape under the slots-over-dp sharding."""
+    return arr.reshape((num_groups, arr.shape[0] // num_groups)
+                       + arr.shape[1:])
+
+
+def _paged_attn_block(p, x, kc, vc, bt_g, cfg: GPT2Config,
+                      num_groups: int, write_pos: jax.Array,
+                      pos_mask: jax.Array, sel: jax.Array):
+    """Shared attention step of the paged decode/verify/prefill paths.
+
+    x: [S, K, H] — K tokens for each of S per-slot query streams, with
+    S = G * Sg (Sg = 1 stream per group for prefill); kc/vc: one
+    layer's [G, B, nH, bs, D]; bt_g: [G, Sg, J]; write_pos: [G, Sg*K]
+    token positions to write; pos_mask: [G, Sg, K, J*bs]; sel:
+    [G, Sg, J, B]. Returns (x', kc', vc').
+    """
+    S, K, H = x.shape
+    G = num_groups
+    Sg = S // G
+    R = Sg * K
+    nH, D = cfg.num_heads, cfg.head_dim
+    q, k, v = _qkv(p, x, cfg)                        # [S, K, nH, D]
+    bs = kc.shape[3]
+    bt_rows = jnp.broadcast_to(bt_g[:, :, None, :],
+                               (G, Sg, K, bt_g.shape[-1])
+                               ).reshape(G, R, -1)
+    blk, off = kv_cache.positions_to_blocks(bt_rows, write_pos, bs)
+    kc = kv_cache.paged_write_rows(kc, k.reshape(G, R, nH, D), blk, off)
+    vc = kv_cache.paged_write_rows(vc, v.reshape(G, R, nH, D), blk, off)
+    attn = kv_cache.paged_attend(q.reshape(G, Sg, K, nH, D), kc, vc, sel,
+                                 pos_mask, 1.0 / math.sqrt(D), NEG_INF)
+    attn = attn.reshape(S, K, H).astype(x.dtype)
+    x = x + dense(attn, p["proj_kernel"], p["proj_bias"])
+    return _ffn(p, x, cfg), kc, vc
+
+
+def gpt2_verify_paged(params: Dict[str, Any], kc: jax.Array,
+                      vc: jax.Array, tokens: jax.Array,
+                      lengths: jax.Array, block_tables: jax.Array,
+                      cfg: GPT2Config, num_groups: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """The speculative verify step — and, at K=1, plain paged decode.
+
+    tokens: [S, K] — column 0 is each slot's pending last token,
+    columns 1.. are the drafted continuation; token i sits at position
+    lengths[s] + i. Writes all K tokens' K/V through the block table,
+    attends each under its own causal row, and returns fp32 logits
+    [S, K, V] (the K-bounded spec-decode analogue of last-position-only
+    logits — never a [max_len, vocab] tensor). kc/vc: the full pool
+    [L, G, B, nH, bs, D].
+    """
+    _check_cfg(cfg)
+    S, K = tokens.shape
+    G = num_groups
+    Sg = S // G
+    J = block_tables.shape[-1]
+    bs = kc.shape[4]
+    pos = lengths[:, None] + jnp.arange(K, dtype=jnp.int32)[None]  # [S,K]
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[pos]
+    bt_g = _group_shape(block_tables, G)             # [G, Sg, J]
+    sel = kv_cache.block_select(bt_g, kc.shape[2])
+    pos_g = _group_shape(pos, G)                     # [G, Sg, K]
+    grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
+    pos_mask = grid <= pos_g[..., None]              # [G, Sg, K, J*bs]
+    write_pos = pos_g.reshape(G, Sg * K)
+
+    def body(h, layer):
+        p, kcl, vcl = layer
+        h, kcl, vcl = _paged_attn_block(p, h, kcl, vcl, bt_g, cfg, G,
+                                        write_pos, pos_mask, sel)
+        return h, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
+    x = layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
+    logits = (x @ params["wte"].astype(cfg.dtype).T).astype(jnp.float32)
+    return logits, kc, vc
+
+
+def gpt2_decode_paged(params: Dict[str, Any], kc: jax.Array,
+                      vc: jax.Array, tokens: jax.Array,
+                      lengths: jax.Array, block_tables: jax.Array,
+                      cfg: GPT2Config, num_groups: int
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """One paged decode step for every slot: the K=1 verify. Returns
+    (logits [S, V] fp32, kc', vc') — same contract as ``gpt2_decode``
+    with the block table standing in for the slot-major rows."""
+    logits, kc, vc = gpt2_verify_paged(params, kc, vc, tokens[:, None],
+                                       lengths, block_tables, cfg,
+                                       num_groups)
+    return logits[:, 0], kc, vc
+
+
+def gpt2_prefill_chunk_paged(params: Dict[str, Any], kc: jax.Array,
+                             vc: jax.Array, tokens: jax.Array,
+                             bt_rows: jax.Array, start: jax.Array,
+                             last_idx: jax.Array, active: jax.Array,
+                             cfg: GPT2Config
+                             ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Group-batched chunked prefill: one prompt chunk for ONE slot per
+    group (the paged twin of ``gpt2_prefill_chunk``).
+
+    tokens: [G, C]; bt_rows: [G, J] — each group's target slot's block
+    table row (DEAD_BLOCK rows for groups with nothing to prefill);
+    start/last_idx/active: [G]. Writes each chunk's K/V through its
+    group's table and attends against the slot's whole cached row under
+    the global-position causal mask. Returns (logits [G, V] fp32 at
+    ``last_idx``, kc', vc'). Inactive groups compute garbage that
+    writes nowhere — the uniform-program rule that keeps ONE compiled
+    shape for any admission pattern.
+    """
+    _check_cfg(cfg)
+    G, C = tokens.shape
+    J = bt_rows.shape[-1]
+    bs = kc.shape[4]
+    pos = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None]  # [G, C]
+    x = params["wte"].astype(cfg.dtype)[tokens] + \
+        params["wpe"].astype(cfg.dtype)[pos]         # [G, C, H]
+    bt_g = jnp.where(active[:, None, None] > 0, bt_rows[:, None],
+                     kv_cache.DEAD_BLOCK)            # [G, 1, J]
+    sel = kv_cache.block_select(bt_g, kc.shape[2])
+    grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
+    pos_mask = grid <= pos[:, None, :, None]         # [G, 1, C, J*bs]
+    write_pos = pos                                  # [G, C]
+
+    def body(h, layer):
+        p, kcl, vcl = layer
+        h, kcl, vcl = _paged_attn_block(p, h, kcl, vcl, bt_g, cfg, G,
+                                        write_pos, pos_mask, sel)
+        return h, (kcl, vcl)
+
+    x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
+    x = layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
+    oh = (lax.broadcasted_iota(jnp.int32, (G, C), 1) ==
+          last_idx[:, None]).astype(x.dtype)
+    h_last = jnp.einsum("gc,gch->gh", oh, x)
+    logits = (h_last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    return logits, kc, vc
+
+
+def gpt2_prefill_full_paged(params: Dict[str, Any], kc: jax.Array,
+                            vc: jax.Array, tokens: jax.Array,
+                            bt_rows: jax.Array, last_idx: jax.Array,
+                            cfg: GPT2Config,
+                            attention_fn: Optional[Callable] = None
+                            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Whole-prompt single-shot prefill (``prefill_chunk: 0``) into the
+    block pool: the same pluggable-attention forward as
+    ``gpt2_prefill_full`` (ring attention plugs in identically), with
+    the per-layer K/V splice routed through the target slot's block
+    table instead of a slot-major ``dynamic_update_slice``. tokens: [T]
+    padded to max_len; bt_rows: [G, J] — the slot's row in its own
+    group, DEAD_BLOCK rows elsewhere, so the write lands only in the
+    owning dp shard."""
+    _check_cfg(cfg)
+    if attention_fn is None:
+        from ..ops.flash_attention import auto_attention
+        attention_fn = auto_attention
+    T = tokens.shape[0]
+    G = bt_rows.shape[0]
+    bs = kc.shape[4]
+    x = (params["wte"].astype(cfg.dtype)[tokens] +
+         params["wpe"].astype(cfg.dtype)[:T])[None]        # [1, T, H]
+
+    def body(h, p):
+        q, k, v = _qkv(p, h, cfg)                  # [1, T, nH, D]
+        attn = attention_fn(q, k, v, mask=None, causal=True,
+                            deterministic=True)
+        attn = attn.reshape(h.shape).astype(h.dtype)
+        h = h + dense(attn, p["proj_kernel"], p["proj_bias"])
+        return _ffn(p, h, cfg), (k[0], v[0])       # ys: [T, nH, D]
+
+    x, (ks, vs) = lax.scan(body, x, params["blocks"])
+    pos = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32)[None], (G, T))
+    bt_per_row = jnp.broadcast_to(bt_rows[:, None, :],
+                                  (G, T, bt_rows.shape[-1]))
+    blk, off = kv_cache.positions_to_blocks(bt_per_row, pos, bs)
+
+    def splice(pool, rows):
+        return kv_cache.paged_write_rows(
+            pool, jnp.broadcast_to(rows[None], (G,) + rows.shape),
+            blk, off)
+
+    kc = jax.vmap(splice)(kc, ks)
+    vc = jax.vmap(splice)(vc, vs)
+    x = layer_norm_fn(cfg)(x[0], params["ln_f_scale"],
+                           params["ln_f_bias"])
+    h_last = lax.dynamic_slice(x, (last_idx.astype(jnp.int32),
+                                   jnp.int32(0)), (1, x.shape[1]))[0]
+    logits = (h_last @ params["wte"].astype(cfg.dtype).T
+              ).astype(jnp.float32)
+    return logits, kc, vc
+
+
+def spec_accept(logits: jax.Array, tokens: jax.Array, key: jax.Array,
+                temperature: jax.Array) -> jax.Array:
+    """In-graph draft acceptance: the longest agreeing prefix rule.
+
+    logits: [S, K, V] from the verify step over [last, d_1..d_{K-1}];
+    tokens: the [S, K] verify input. Greedy target g[s,i] =
+    argmax(logits[s,i]); draft d_i is accepted iff every d_{i'<=i}
+    matched g at its position, and the emitted stream is g[s, :m+1]
+    (accepted drafts ARE the greedy tokens, plus the first correction /
+    bonus) — which is exactly what non-speculative greedy decode would
+    have produced token by token. Returns [S, K+1] int32: column 0 is
+    n_new (how many of the following tokens are real), columns 1..K the
+    emitted tokens — one array, ONE host fetch per iteration.
+    """
+    S, K = tokens.shape
+    g = sample_tokens(logits, key, temperature)          # [S, K]
+    match = (tokens[:, 1:] == g[:, :-1]).astype(jnp.int32)   # [S, K-1]
+    acc = jnp.cumprod(match, axis=-1).sum(-1) if K > 1 else \
+        jnp.zeros((S,), jnp.int32)
+    n_new = (acc + 1).astype(jnp.int32)                  # [S]
+    return jnp.concatenate([n_new[:, None], g], axis=-1)
+
+
 # --------------------------------------------------------------------- #
 # Sampling (in-graph; PRNG threaded by the engine per iteration)
 # --------------------------------------------------------------------- #
@@ -249,4 +486,6 @@ def sample_tokens(logits: jax.Array, key: jax.Array,
 
 
 __all__ = ["gpt2_decode", "gpt2_prefill_chunk", "gpt2_prefill_full",
-           "sample_tokens"]
+           "gpt2_decode_paged", "gpt2_verify_paged",
+           "gpt2_prefill_chunk_paged", "gpt2_prefill_full_paged",
+           "spec_accept", "sample_tokens"]

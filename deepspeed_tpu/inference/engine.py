@@ -42,7 +42,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import decode as decode_mod
 from . import kv_cache
-from .quantize import dequantize, quantize_params, quantized_bytes
+from .quantize import (dequantize, quantize_params, quantized_bytes,
+                       resolve_kv_dtype)
+from .spec import NGramDrafter
 from .. import constants as C
 from ..models.gpt2 import GPT2Config
 from ..monitor import Telemetry
@@ -96,6 +98,28 @@ class InferenceEngine:
             raise ValueError(
                 f"whole-prompt prefill with a seq axis needs max_seq_len "
                 f"({self.max_len}) divisible by sp={self.sp}")
+        self.block_size = int(self.icfg.block_size)
+        self.paged = self.block_size > 0
+        if self.paged and self.max_len % self.block_size:
+            raise ValueError(
+                f"inference.block_size={self.block_size} must divide "
+                f"inference.max_seq_len ({self.max_len}); set "
+                "block_size: 0 for the slot-major layout")
+        self.spec_k = int(self.icfg.spec_k)
+        self.replica = str(self.icfg.replica)
+        self.num_blocks = int(self.icfg.num_blocks)
+        if self.paged and self.num_blocks == 0:
+            # Full provisioning: every slot can reach max_len, so
+            # admission never blocks on HBM (the PR-7-equivalent
+            # capacity); smaller pools oversubscribe and the admission
+            # gate accounts free blocks.
+            self.num_blocks = self.max_slots * \
+                (self.max_len // self.block_size)
+        if self.paged and self.num_blocks % self.dp:
+            raise ValueError(
+                f"inference.num_blocks={self.num_blocks} must be "
+                f"divisible by the mesh data axis ({self.dp}) — blocks "
+                "are born sharded over dp alongside their slots")
 
         # --- weights: quantize, then commit to the mesh ---
         self.quantize = self.icfg.quantize
@@ -115,29 +139,61 @@ class InferenceEngine:
         self._params = jax.device_put(params, shardings)
         self.param_bytes = quantized_bytes(self._params)
 
-        # --- the KV cache, born sharded ---
-        self.cache_spec = kv_cache.KVCacheSpec(
-            num_layers=model_cfg.num_layers, num_slots=self.max_slots,
-            num_heads=model_cfg.num_heads, max_len=self.max_len,
-            head_dim=model_cfg.head_dim, dtype=model_cfg.dtype)
-        self.cache = kv_cache.init_cache(self.cache_spec, self.mesh)
-        self._cache_sh = kv_cache.cache_shardings(self.mesh)
+        # --- the KV cache, born sharded: paged block pool (production)
+        # or the PR-7 slot-major rows (block_size: 0 — the parity
+        # baseline) ---
+        kv_dtype = resolve_kv_dtype(self.icfg.kv_cache_dtype,
+                                    model_cfg.dtype)
+        if self.paged:
+            self.cache_spec = kv_cache.PagedKVCacheSpec(
+                num_layers=model_cfg.num_layers,
+                num_slots=self.max_slots, num_blocks=self.num_blocks,
+                block_size=self.block_size, max_len=self.max_len,
+                num_heads=model_cfg.num_heads,
+                head_dim=model_cfg.head_dim, num_groups=self.dp,
+                dtype=kv_dtype)
+            self.cache = kv_cache.init_paged_cache(self.cache_spec,
+                                                   self.mesh)
+            self._cache_sh = kv_cache.paged_shardings(self.mesh)
+            self.allocator = kv_cache.BlockAllocator(self.cache_spec)
+            self.block_tables = np.full(
+                (self.max_slots, self.cache_spec.max_blocks_per_slot),
+                kv_cache.DEAD_BLOCK, np.int32)
+        else:
+            self.cache_spec = kv_cache.KVCacheSpec(
+                num_layers=model_cfg.num_layers, num_slots=self.max_slots,
+                num_heads=model_cfg.num_heads, max_len=self.max_len,
+                head_dim=model_cfg.head_dim, dtype=kv_dtype)
+            self.cache = kv_cache.init_cache(self.cache_spec, self.mesh)
+            self._cache_sh = kv_cache.cache_shardings(self.mesh)
+            self.allocator = None
+            self.block_tables = None
+        self.drafter = NGramDrafter(self.spec_k, self.icfg.spec_ngram) \
+            if self.spec_k > 0 else None
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
         # --- host-authoritative per-slot counters ---
         self.lengths = np.zeros(self.max_slots, np.int32)
         self.active = np.zeros(self.max_slots, bool)
         self.last_tokens = np.zeros(self.max_slots, np.int32)
+        self._held = set()               # acquired, not yet activated
 
         # --- telemetry on the shared spine ---
         self.iterations = 0
         self._rng_calls = 0
-        self.serving = ServingAggregator(self.max_slots)
+        self.serving = ServingAggregator(self.max_slots,
+                                         label=self.replica or None)
         self.telemetry = Telemetry(
             self.tcfg, default_report_steps=50,
             meta=dict(mode="serving", model=model_cfg.name,
                       dp=self.dp, mp=self.mp, sp=self.sp,
                       max_slots=self.max_slots, max_seq_len=self.max_len,
                       prefill_chunk=self.prefill_chunk,
+                      block_size=self.block_size,
+                      num_blocks=self.num_blocks if self.paged else 0,
+                      spec_k=self.spec_k,
+                      replica=self.replica,
                       quantize=self.quantize,
                       precision=jnp.dtype(model_cfg.dtype).name,
                       param_bytes=self.param_bytes,
@@ -148,19 +204,32 @@ class InferenceEngine:
         self.telemetry.set_analytic_footprint(analytic_state_bytes(
             {"params": self._params, "cache": self.cache}))
 
-        # --- the two compiled paths (sentinel-instrumented) ---
+        # --- the compiled paths (sentinel-instrumented): decode +
+        # prefill always; paged engines add the copy-on-write block copy
+        # and, with spec_k > 0, the speculative verify step. Each has
+        # ONE abstract signature for the engine's lifetime ---
         self._decode_fn = self.telemetry.instrument_step_fn(
             "decode_step", self._build_decode_step())
         self._prefill_fn = self.telemetry.instrument_step_fn(
             "prefill_step", self._build_prefill_step())
+        if self.paged:
+            self._copy_fn = self.telemetry.instrument_step_fn(
+                "copy_block", self._build_copy_block())
+        if self.paged and self.spec_k > 0:
+            self._verify_fn = self.telemetry.instrument_step_fn(
+                "verify_step", self._build_verify_step())
 
+        layout = (f"paged bs={self.block_size} x{self.num_blocks} blocks"
+                  if self.paged else "slot-major")
         log_dist(
             f"InferenceEngine initialized: {model_cfg.name}, "
             f"slots={self.max_slots} (dp={self.dp}), "
-            f"cache={self.max_len}x{model_cfg.num_heads}h "
+            f"cache={layout} {self.max_len}x{model_cfg.num_heads}h "
             f"({self.cache_spec.nbytes() / 2 ** 20:.1f} MiB K+V), "
             f"prefill={'full' if self.prefill_chunk == 0 else f'chunk {self.prefill_chunk}'}, "
-            f"quantize={self.quantize}", ranks=[0])
+            f"spec_k={self.spec_k}, quantize={self.quantize}"
+            + (f", replica={self.replica}" if self.replica else ""),
+            ranks=[0])
 
     # ------------------------------------------------------------------ #
     # Compiled-path builders
@@ -174,11 +243,18 @@ class InferenceEngine:
 
     def _build_decode_step(self) -> Callable:
         cfg = self.model_cfg
+        dp = self.dp
 
-        def decode_step(params, kc, vc, tokens, lengths, key, temperature):
+        def decode_step(params, kc, vc, tokens, lengths, bt, key,
+                        temperature):
             p = self._runtime_params(params)
-            logits, kc, vc = decode_mod.gpt2_decode(p, kc, vc, tokens,
-                                                    lengths, cfg)
+            if self.paged:
+                logits, kc, vc = decode_mod.gpt2_decode_paged(
+                    p, kc, vc, tokens, lengths, bt, cfg, dp)
+            else:
+                logits, kc, vc = decode_mod.gpt2_decode(p, kc, vc,
+                                                        tokens, lengths,
+                                                        cfg)
             sampled = decode_mod.sample_tokens(logits, key, temperature)
             return kc, vc, sampled, logits
 
@@ -188,34 +264,91 @@ class InferenceEngine:
 
     def _build_prefill_step(self) -> Callable:
         cfg = self.model_cfg
+        dp = self.dp
         attention_fn = None
         if self.prefill_chunk == 0 and self.sp > 1:
             from ..ops.ring_attention import ring_attention_fn
             attention_fn = ring_attention_fn(self.mesh)
-
-        def prefill_step(params, kc, vc, tokens, slot, start, last_idx,
-                         key, temperature):
-            p = self._runtime_params(params)
-            if self.prefill_chunk == 0:
-                logits, kc, vc = decode_mod.gpt2_prefill_full(
-                    p, kc, vc, tokens, slot, last_idx, cfg,
-                    attention_fn=attention_fn)
-            else:
-                logits, kc, vc = decode_mod.gpt2_prefill_chunk(
-                    p, kc, vc, tokens, slot, start, last_idx, cfg)
-            sampled = decode_mod.sample_tokens(logits, key, temperature)
-            return kc, vc, sampled, logits
-
         sh = self._cache_sh
+
+        if self.paged and self.prefill_chunk > 0:
+            # Group-batched chunked prefill: one chunk of one slot per
+            # dp group (single admissions leave the other groups' rows
+            # DEAD — uniform program, writes land nowhere).
+            def prefill_step(params, kc, vc, tokens, bt_rows, start,
+                             last_idx, active, key, temperature):
+                p = self._runtime_params(params)
+                logits, kc, vc = decode_mod.gpt2_prefill_chunk_paged(
+                    p, kc, vc, tokens, bt_rows, start, last_idx,
+                    active, cfg)
+                sampled = decode_mod.sample_tokens(logits, key,
+                                                   temperature)
+                return kc, vc, sampled, logits
+        elif self.paged:
+            def prefill_step(params, kc, vc, tokens, bt_rows, last_idx,
+                             key, temperature):
+                p = self._runtime_params(params)
+                logits, kc, vc = decode_mod.gpt2_prefill_full_paged(
+                    p, kc, vc, tokens, bt_rows, last_idx, cfg,
+                    attention_fn=attention_fn)
+                sampled = decode_mod.sample_tokens(logits, key,
+                                                   temperature)
+                return kc, vc, sampled, logits
+        else:
+            def prefill_step(params, kc, vc, tokens, slot, start,
+                             last_idx, key, temperature):
+                p = self._runtime_params(params)
+                if self.prefill_chunk == 0:
+                    logits, kc, vc = decode_mod.gpt2_prefill_full(
+                        p, kc, vc, tokens, slot, last_idx, cfg,
+                        attention_fn=attention_fn)
+                else:
+                    logits, kc, vc = decode_mod.gpt2_prefill_chunk(
+                        p, kc, vc, tokens, slot, start, last_idx, cfg)
+                sampled = decode_mod.sample_tokens(logits, key,
+                                                   temperature)
+                return kc, vc, sampled, logits
+
         return jax.jit(prefill_step, donate_argnums=(1, 2),
                        out_shardings=(sh["k"], sh["v"], None, None))
+
+    def _build_verify_step(self) -> Callable:
+        """Speculative draft-then-verify: one batched K=spec_k+1 step,
+        in-graph acceptance (decode.spec_accept), ONE [S, K+2] int32
+        readback — the same single host fetch per iteration plain
+        decode pays."""
+        cfg = self.model_cfg
+        dp = self.dp
+
+        def verify_step(params, kc, vc, tokens, lengths, bt, key,
+                        temperature):
+            p = self._runtime_params(params)
+            logits, kc, vc = decode_mod.gpt2_verify_paged(
+                p, kc, vc, tokens, lengths, bt, cfg, dp)
+            out = decode_mod.spec_accept(logits, tokens, key, temperature)
+            return kc, vc, out, logits
+
+        sh = self._cache_sh
+        return jax.jit(verify_step, donate_argnums=(1, 2),
+                       out_shardings=(sh["k"], sh["v"], None, None))
+
+    def _build_copy_block(self) -> Callable:
+        """The device half of copy-on-write: duplicate one block's K/V
+        rows (all layers) into a private block of the same group."""
+        def copy_block(kc, vc, src_onehot, dst_onehot):
+            return (kv_cache.paged_copy_block(kc, src_onehot, dst_onehot),
+                    kv_cache.paged_copy_block(vc, src_onehot, dst_onehot))
+
+        sh = self._cache_sh
+        return jax.jit(copy_block, donate_argnums=(0, 1),
+                       out_shardings=(sh["k"], sh["v"]))
 
     def _next_key(self) -> jax.Array:
         self._rng_calls += 1
         return jax.random.fold_in(self._base_rng, self._rng_calls)
 
     # ------------------------------------------------------------------ #
-    # Slot lifecycle (host counters only — no device work)
+    # Slot lifecycle (host counters + block accounting — no device work)
     # ------------------------------------------------------------------ #
     def activate_slot(self, slot: int, context_len: int,
                       last_token: int) -> None:
@@ -225,13 +358,26 @@ class InferenceEngine:
         self.lengths[slot] = int(context_len)
         self.active[slot] = True
         self.last_tokens[slot] = int(last_token)
+        self._held.discard(slot)
+        if self.drafter is not None:
+            self.drafter.observe(slot, [int(last_token)])
 
     def release_slot(self, slot: int) -> None:
-        """Evict: counters clear; the stale cache rows are dead by
-        masking and get overwritten by the next occupant."""
+        """Evict: counters clear and (paged) every block reference
+        drops — private blocks return to the free list, prefix blocks
+        whose refcount hits zero are LRU-retained for future hits. The
+        stale rows are dead by masking either way."""
         self.active[slot] = False
         self.lengths[slot] = 0
         self.last_tokens[slot] = 0
+        self._held.discard(slot)
+        if self.paged:
+            row = self.block_tables[slot]
+            self.allocator.release(
+                slot, [int(b) for b in row if b != kv_cache.DEAD_BLOCK])
+            row[:] = kv_cache.DEAD_BLOCK
+        if self.drafter is not None:
+            self.drafter.reset(slot)
 
     def context_len(self, slot: int) -> int:
         return int(self.lengths[slot])
@@ -240,17 +386,112 @@ class InferenceEngine:
     def active_slots(self) -> int:
         return int(self.active.sum())
 
+    @property
+    def spec_enabled(self) -> bool:
+        return self.paged and self.spec_k > 0
+
+    def _ensure_blocks(self, slot: int, upto_pos: int) -> None:
+        """Lazily allocate table entries so ``slot`` can write token
+        positions up to ``upto_pos`` — the per-iteration HBM growth the
+        hbm_bytes_per_token metric tracks."""
+        J = self.cache_spec.max_blocks_per_slot
+        need_j = min(upto_pos // self.block_size, J - 1)
+        row = self.block_tables[slot]
+        j = int((row != kv_cache.DEAD_BLOCK).sum())
+        while j <= need_j:
+            row[j] = self.allocator.alloc_block(slot)
+            j += 1
+
+    # ------------------------------------------------------------------ #
+    # Admission (the scheduler's gate): slot occupancy AND HBM blocks
+    # ------------------------------------------------------------------ #
+    def group_of(self, slot: int) -> int:
+        """The dp group (pool shard) a slot's blocks live in."""
+        return slot // self.cache_spec.slots_per_group if self.paged \
+            else 0
+
+    def select_slot(self, prompt: Sequence[int],
+                    max_new_tokens: int = 0,
+                    exclude_groups: Optional[set] = None
+                    ) -> Optional[int]:
+        """Pick and HOLD a free slot for this prompt, or None when the
+        engine cannot admit it now.
+
+        Paged engines extend the gate from slot occupancy to HBM
+        accounting: a group must cover the request's worst-case block
+        need (``BlockAllocator.can_admit``), and among admissible
+        groups the one already holding the longest cached prefix of
+        this prompt wins (prefix affinity — the request lands where its
+        blocks live), ties broken toward the most available HBM. The
+        hold is released by ``activate_slot`` or ``release_slot``.
+        ``exclude_groups`` lets the scheduler gather a one-slot-per-
+        group admission batch for ``prefill_many``."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        free = [s for s in range(self.max_slots)
+                if not self.active[s] and s not in self._held]
+        if not free:
+            return None
+        if not self.paged:
+            self._held.add(free[0])
+            return free[0]
+        share = self.prefill_chunk > 0
+        Sg = self.cache_spec.slots_per_group
+        first_free: Dict[int, int] = {}
+        for s in free:
+            g = s // Sg
+            if exclude_groups and g in exclude_groups:
+                continue
+            first_free.setdefault(g, s)
+        best = None
+        best_key = None
+        for g, s in first_free.items():
+            if not self.allocator.can_admit(g, prompt,
+                                            int(max_new_tokens),
+                                            self.spec_k, share=share):
+                continue
+            matched = len(self.allocator.match_prefix(g, prompt)[0]) \
+                if share else 0
+            key = (matched, self.allocator.available(g))
+            if best_key is None or key > best_key:
+                best, best_key = s, key
+        if best is not None:
+            self._held.add(best)
+        return best
+
+    def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
+        """Longest cached prompt prefix (tokens) resident anywhere in
+        this engine's block pool — the router's affinity signal. Host
+        hash walk only; zero device work."""
+        if not self.paged or self.prefill_chunk == 0:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        best = 0
+        for g in range(self.dp):
+            best = max(best,
+                       len(self.allocator.match_prefix(g, prompt)[0]))
+        return best * self.block_size
+
     # ------------------------------------------------------------------ #
     # The two serving operations
     # ------------------------------------------------------------------ #
     def prefill(self, prompt: Sequence[int], slot: int,
-                temperature: float = 0.0, return_logits: bool = False
+                temperature: float = 0.0, return_logits: bool = False,
+                max_new_tokens: Optional[int] = None
                 ) -> Tuple[int, Optional[np.ndarray]]:
         """Prefill one prompt into ``slot`` and sample its first output
         token. Returns (token, final-position logits [V] when asked —
         parity tests only; the serving loop needs just the token, and a
         per-admission [V] fetch would be a wasted host transfer). The
-        caller activates the slot (scheduler owns admission ordering)."""
+        caller activates the slot (scheduler owns admission ordering).
+
+        Paged engines first admit the prompt through the block
+        allocator: cached full-block prefixes are shared by refcount
+        (only the tail re-prefills — the TTFT win), an exactly-matched
+        chain forks its final block copy-on-write before the first
+        write, and ``max_new_tokens`` (the scheduler passes the
+        request's) books the worst-case HBM reservation so mid-flight
+        appends can never strand the slot. Direct calls without it
+        reserve nothing and draw from the free pool lazily."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
         if plen < 1:
@@ -261,31 +502,175 @@ class InferenceEngine:
                 f"{self.max_len}-token slot")
         kc, vc = self.cache["k"], self.cache["v"]
         temp = np.float32(temperature)
-        if self.prefill_chunk == 0:
+        if not self.paged:
+            if self.prefill_chunk == 0:
+                padded = np.zeros(self.max_len, np.int32)
+                padded[:plen] = prompt
+                kc, vc, tok, logits = self._prefill_fn(
+                    self._params, kc, vc, padded, np.int32(slot),
+                    np.int32(0), np.int32(plen - 1), self._next_key(),
+                    temp)
+            else:
+                chunk = self.prefill_chunk
+                n_chunks = -(-plen // chunk)
+                padded = np.zeros(n_chunks * chunk, np.int32)
+                padded[:plen] = prompt
+                tok = logits = None
+                for ci in range(n_chunks):
+                    start = ci * chunk
+                    last = ci == n_chunks - 1
+                    last_idx = (plen - 1 - start) if last else 0
+                    kc, vc, tok, logits = self._prefill_fn(
+                        self._params, kc, vc, padded[start:start + chunk],
+                        np.int32(slot), np.int32(start),
+                        np.int32(last_idx), self._next_key(), temp)
+        elif self.prefill_chunk == 0:
+            G = self.dp
+            J = self.cache_spec.max_blocks_per_slot
+            group = slot // self.cache_spec.slots_per_group
+            plan = self.allocator.admit_prompt(
+                slot, group, prompt, int(max_new_tokens or 0),
+                self.spec_k, share=False)
+            row = np.full(J, kv_cache.DEAD_BLOCK, np.int32)
+            row[:len(plan.table)] = plan.table
+            self.block_tables[slot] = row
             padded = np.zeros(self.max_len, np.int32)
             padded[:plen] = prompt
+            bt_rows = np.full((G, J), kv_cache.DEAD_BLOCK, np.int32)
+            bt_rows[group] = row
             kc, vc, tok, logits = self._prefill_fn(
-                self._params, kc, vc, padded, np.int32(slot),
-                np.int32(0), np.int32(plen - 1), self._next_key(), temp)
+                self._params, kc, vc, padded, bt_rows,
+                np.int32(plen - 1), self._next_key(), temp)
+            if self.drafter is not None:
+                self.drafter.begin(slot, prompt)
+            self.serving.note_admit(plen, 0)
         else:
-            chunk = self.prefill_chunk
-            n_chunks = -(-plen // chunk)
-            padded = np.zeros(n_chunks * chunk, np.int32)
-            padded[:plen] = prompt
-            tok = logits = None
-            for ci in range(n_chunks):
-                start = ci * chunk
-                last = ci == n_chunks - 1
-                last_idx = (plen - 1 - start) if last else 0
-                kc, vc, tok, logits = self._prefill_fn(
-                    self._params, kc, vc, padded[start:start + chunk],
-                    np.int32(slot), np.int32(start), np.int32(last_idx),
-                    self._next_key(), temp)
+            self.cache["k"], self.cache["v"] = kc, vc
+            tok, logits = self.prefill_many(
+                [(slot, prompt, int(max_new_tokens or 0))], temperature,
+                return_logits=return_logits)[0]
+            return tok, logits
         self.cache["k"], self.cache["v"] = kc, vc
         self.telemetry.raise_pending()
         out_logits = np.asarray(jax.device_get(logits)) \
             if return_logits else None
         return int(jax.device_get(tok)), out_logits
+
+    def prefill_many(self, admissions: Sequence[Tuple[int, Any, int]],
+                     temperature: float = 0.0,
+                     return_logits: bool = False
+                     ) -> "list[Tuple[int, Optional[np.ndarray]]]":
+        """Batched admission: prefill up to ONE slot per dp group in a
+        single pass of group-batched chunk programs.
+
+        ``admissions``: [(slot, prompt, max_new_tokens)] with every slot
+        in a DISTINCT group — the scheduler gathers them that way. A
+        lone admission leaves the other groups computing masked garbage
+        (the uniform program); a full batch does real work in every
+        group, which is what keeps saturation-time TTFT flat as dp
+        grows: G admissions cost one admission's wall. Copy-on-write
+        forks across the batch merge into ONE block-copy call (distinct
+        groups can't collide). Returns [(first token, logits|None)] in
+        admission order."""
+        if not (self.paged and self.prefill_chunk > 0):
+            raise RuntimeError("prefill_many needs the paged cache and "
+                               "chunked prefill")
+        G = self.dp
+        J = self.cache_spec.max_blocks_per_slot
+        Sg = self.cache_spec.slots_per_group
+        chunk = self.prefill_chunk
+        temp = np.float32(temperature)
+        kc, vc = self.cache["k"], self.cache["v"]
+        plans = []
+        seen_groups = set()
+        cow_src = np.zeros((G, self.cache_spec.blocks_per_group),
+                           np.float32)
+        cow_dst = np.zeros((G, self.cache_spec.blocks_per_group), bool)
+        any_cow = False
+        for slot, prompt, max_new in admissions:
+            prompt = np.asarray(prompt, np.int32).reshape(-1)
+            plen = int(prompt.shape[0])
+            if plen < 1:
+                raise ValueError("empty prompt")
+            if plen >= self.max_len:
+                raise ValueError(
+                    f"prompt length {plen} leaves no room to generate "
+                    f"in a {self.max_len}-token slot")
+            group = slot // Sg
+            if group in seen_groups:
+                raise ValueError(
+                    f"prefill_many: two admissions in group {group} — "
+                    "batch at most one slot per dp group")
+            seen_groups.add(group)
+            plan = self.allocator.admit_prompt(
+                slot, group, prompt, int(max_new), self.spec_k)
+            row = np.full(J, kv_cache.DEAD_BLOCK, np.int32)
+            row[:len(plan.table)] = plan.table
+            self.block_tables[slot] = row
+            if plan.cow_src is not None:
+                cow_src[group, plan.cow_src] = 1.0
+                cow_dst[group, plan.cow_dst] = True
+                any_cow = True
+            plans.append((slot, group, plan, prompt, plen))
+        if any_cow:
+            kc, vc = self._copy_fn(kc, vc, cow_src, cow_dst)
+        # Chunk schedule: admission a runs chunks over its unshared
+        # tail; all admissions advance together, groups whose tail is
+        # done go inactive (writes land nowhere).
+        tails = []
+        for slot, group, plan, prompt, plen in plans:
+            tlen = plen - plan.matched
+            n_chunks = -(-tlen // chunk)
+            padded = np.zeros(n_chunks * chunk, np.int32)
+            padded[:tlen] = prompt[plan.matched:]
+            tails.append((padded, n_chunks, tlen))
+        max_chunks = max(n for _, n, _ in tails)
+        held = {}                       # slot -> (ci, group) of its last chunk
+        steps = []                      # per-ci (tok_g, logits_g) device arrays
+        for ci in range(max_chunks):
+            toks = np.zeros((G, chunk), np.int32)
+            bt_rows = np.full((G, J), kv_cache.DEAD_BLOCK, np.int32)
+            starts = np.zeros(G, np.int32)
+            last_idxs = np.zeros(G, np.int32)
+            act = np.zeros(G, np.int32)
+            for (slot, group, plan, prompt, plen), \
+                    (padded, n_chunks, tlen) in zip(plans, tails):
+                if ci >= n_chunks:
+                    continue
+                toks[group] = padded[ci * chunk:(ci + 1) * chunk]
+                bt_rows[group] = self.block_tables[slot]
+                starts[group] = plan.matched + ci * chunk
+                act[group] = 1
+                if ci == n_chunks - 1:
+                    last_idxs[group] = tlen - 1 - ci * chunk
+                    held[slot] = (ci, group)
+            kc, vc, tok_g, logits_g = self._prefill_fn(
+                self._params, kc, vc, toks, bt_rows, starts, last_idxs,
+                act, self._next_key(), temp)
+            steps.append((tok_g, logits_g))
+        self.cache["k"], self.cache["v"] = kc, vc
+        self.telemetry.raise_pending()
+        out = []
+        for slot, group, plan, prompt, plen in plans:
+            ci, g = held[slot]
+            tok = int(jax.device_get(steps[ci][0][g]))
+            logits = np.asarray(jax.device_get(steps[ci][1][g])) \
+                if return_logits else None
+            if self.drafter is not None:
+                self.drafter.begin(slot, prompt)
+            self.serving.note_admit(plen, plan.matched)
+            out.append((tok, logits))
+        return out
+
+    def _cache_accounting(self) -> Tuple[int, int]:
+        """(cache bytes held, context tokens cached) this iteration —
+        the hbm_bytes_per_token sample. Slot-major reserves the full
+        cache whatever the contexts hold; paged holds only live
+        blocks."""
+        tokens = int(self.lengths[self.active].sum())
+        if self.paged:
+            return self.allocator.bytes_in_use(), tokens
+        return self.cache_spec.nbytes(), tokens
 
     def decode_once(self, temperature: float = 0.0,
                     return_logits: bool = False
@@ -297,9 +682,15 @@ class InferenceEngine:
         is not part of the serving loop)."""
         t0 = time.perf_counter()
         n_active = self.active_slots
+        if self.paged:
+            for s in np.flatnonzero(self.active):
+                self._ensure_blocks(int(s), int(self.lengths[s]))
+            bt = self.block_tables
+        else:
+            bt = np.int32(0)            # unused by the slot-major path
         kc, vc, sampled, logits = self._decode_fn(
             self._params, self.cache["k"], self.cache["v"],
-            self.last_tokens, self.lengths, self._next_key(),
+            self.last_tokens, self.lengths, bt, self._next_key(),
             np.float32(temperature))
         self.cache["k"], self.cache["v"] = kc, vc
         self.telemetry.raise_pending()
@@ -309,9 +700,15 @@ class InferenceEngine:
         adv = self.active
         self.lengths[adv] += 1
         self.last_tokens[adv] = sampled[adv]
+        if self.drafter is not None:
+            for s in np.flatnonzero(adv):
+                self.drafter.observe(int(s), [int(sampled[s])])
         wall = time.perf_counter() - t0
         self.iterations += 1
-        self.serving.note_iteration(n_active, wall)
+        cache_bytes, ctx_tokens = self._cache_accounting()
+        self.serving.note_iteration(n_active, wall,
+                                    cache_bytes=cache_bytes,
+                                    context_tokens=ctx_tokens)
         tl = self.telemetry
         if tl.enabled:
             tl.record_step(self.iterations, {},
@@ -323,6 +720,91 @@ class InferenceEngine:
         out_logits = np.asarray(jax.device_get(logits)) \
             if return_logits else None
         return sampled, out_logits
+
+    def spec_decode_once(self, temperature: float = 0.0
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+        """One speculative draft-then-verify iteration for every slot.
+
+        The n-gram drafter proposes ``spec_k`` tokens per live slot
+        (host-side, free), ONE batched verify step scores
+        [last, d_1..d_k] through the paged cache, and the in-graph
+        acceptance rule emits the longest agreeing prefix plus the
+        correction/bonus token — 1..k+1 tokens per slot per iteration,
+        greedy-bit-identical to plain decode. Still exactly one host
+        fetch. Returns (emitted [S, k+1] int32, n_new [S] — how many
+        leading emitted tokens are real per slot; 0 for inactive)."""
+        if not self.spec_enabled:
+            raise RuntimeError("spec_decode_once needs inference.spec_k "
+                               "> 0 and the paged cache")
+        if float(temperature) > 0.0:
+            raise ValueError(
+                "spec_decode_once is greedy-only (the acceptance rule "
+                "has no rejection-sampling correction); use "
+                "decode_once for temperature > 0 — the scheduler falls "
+                "back automatically")
+        t0 = time.perf_counter()
+        k = self.spec_k
+        n_active = self.active_slots
+        toks = np.zeros((self.max_slots, k + 1), np.int32)
+        toks[:, 0] = self.last_tokens
+        live = np.flatnonzero(self.active)
+        for s in live:
+            s = int(s)
+            toks[s, 1:] = self.drafter.propose(s)
+            self._ensure_blocks(
+                s, min(int(self.lengths[s]) + k, self.max_len - 1))
+        kc, vc, out, logits = self._verify_fn(
+            self._params, self.cache["k"], self.cache["v"], toks,
+            self.lengths, self.block_tables, self._next_key(),
+            np.float32(temperature))
+        self.cache["k"], self.cache["v"] = kc, vc
+        self.telemetry.raise_pending()
+        out = np.asarray(jax.device_get(out))        # [S, k+2]
+        n_new = out[:, 0].copy()
+        emitted = out[:, 1:]
+        n_new[~self.active] = 0
+        accepted = 0
+        for s in live:
+            s = int(s)
+            n = max(0, min(int(n_new[s]),
+                           self.max_len - int(self.lengths[s])))
+            n_new[s] = n
+            if n == 0:
+                continue
+            self.lengths[s] += n
+            self.last_tokens[s] = int(emitted[s, n - 1])
+            self.drafter.observe(s, emitted[s, :n])
+            accepted += n - 1
+        emitted_total = int(n_new.sum())
+        self._spec_proposed += k * len(live)
+        self._spec_accepted += accepted
+        wall = time.perf_counter() - t0
+        self.iterations += 1
+        cache_bytes, ctx_tokens = self._cache_accounting()
+        self.serving.note_iteration(n_active, wall,
+                                    cache_bytes=cache_bytes,
+                                    context_tokens=ctx_tokens,
+                                    emitted_tokens=emitted_total)
+        self.serving.note_spec(k * len(live), accepted)
+        tl = self.telemetry
+        if tl.enabled:
+            tl.record_step(self.iterations, {},
+                           wall_ms=wall * 1e3,
+                           active_slots=n_active,
+                           occupancy=round(n_active / self.max_slots, 4),
+                           tokens=emitted_total,
+                           spec_accepted=accepted)
+            tl.maybe_drain(self.iterations, extra_fn=self._report_extra)
+        return emitted, n_new
+
+    def reset_serving_stats(self) -> None:
+        """Fresh aggregator window (benches call this after a warmup
+        pass so compile time never pollutes the measured TTFT/TPOT
+        stream — both sides of a comparison warm the same way)."""
+        self.serving = ServingAggregator(self.max_slots,
+                                         label=self.replica or None)
+        self._spec_proposed = 0
+        self._spec_accepted = 0
 
     def _report_extra(self) -> Dict[str, Any]:
         return {"serving": self.serving.snapshot()}
@@ -340,6 +822,8 @@ class InferenceEngine:
                        "new_tokens": int(new_tokens)}
             if tpot_s is not None:
                 payload["tpot_ms"] = round(tpot_s * 1e3, 3)
+            if self.replica:
+                payload["replica"] = self.replica
             self.telemetry.event("request_complete", payload)
 
     def serve(self, requests, temperature: float = 0.0, **kwargs):

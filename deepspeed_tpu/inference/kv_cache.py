@@ -1,42 +1,53 @@
-"""Slot-major KV cache — the static-shape memory plane of the serving
-tier.
+"""KV cache — the static-shape memory plane of the serving tier.
 
-Design (the memory-layout insight behind iteration-level batching): the
-cache is ONE pair of arrays per model,
+Two layouts share this module:
 
-    k, v : [layers, slots, heads, max_len, head_dim]
+**Paged (the production layout — the PagedAttention/vLLM design).** The
+cache is a pool of fixed-size blocks,
 
-whose shape never changes for the lifetime of the engine. A request does
-not own a tensor — it owns a SLOT index and a length counter. Insert is
-a ``dynamic_update_slice`` of the prefilled K/V block into the slot's
-rows; evict is a counter clear (the stale rows are dead by masking and
-get overwritten as the next occupant's context grows). Nothing about
-admission, progress, or eviction changes any compiled signature — that
-is the property the recompile sentinel gates in the serving tests.
+    k, v : [layers, groups, blocks_per_group, heads, block_size, head_dim]
 
-Sharding: born on the training mesh's axes — ``slots`` over the data
-axis (slot-parallel decode, the serving analogue of the data-parallel
-batch) and ``heads`` over the model axis (Megatron TP head sharding,
-matching ``models/transformer.block_param_shardings``). Every decode-
-step op keeps the slot dim leading and elementwise/contraction-local, so
-GSPMD partitions the whole step without gathering the cache.
+and a request owns a list of BLOCK IDS (its block table row), not a
+``max_seq_len`` reservation: short and long requests share HBM, blocks
+allocate lazily as a context grows, and common prompt prefixes are
+shared copy-on-write across requests — full-block granularity, keyed by
+a position-dependent chain hash, reference-counted by the host-side
+``BlockAllocator``. The ``groups`` axis is the mesh data axis: a slot's
+blocks always live in the slot's own dp shard (the allocator enforces
+it), so every decode-step gather through the block table is a
+GROUP-BATCHED one-hot contraction — GSPMD partitions it with zero
+communication and no per-device transient ever exceeds the pool shard
+(the ``materialization`` lint gate proves it: no full-pool gather).
 
-The per-token append across slots with HETEROGENEOUS lengths (continuous
-batching's defining access pattern) is a one-hot select over the length
-axis rather than a scatter: GSPMD partitions a select trivially along
-slots and heads, while a scatter with per-slot indices risks the exact
-full-cache gather the lint gate forbids. The cost is a full cache
+**Slot-major (the PR-7 layout, ``block_size: 0``).** One
+``[slots, max_len]`` row per slot — kept as the parity baseline the
+paged tests diff against and as the fallback for models whose
+``max_seq_len`` the page size does not divide.
+
+In both layouts nothing about admission, progress, or eviction changes
+a compiled signature — that is the property the recompile sentinel
+gates in the serving tests. Sharding is born on the training mesh's
+axes: slots/groups over the data axis, ``heads`` over the model axis
+(Megatron TP head sharding, matching
+``models/transformer.block_param_shardings``).
+
+Appends and block gathers are one-hot selects/contractions rather than
+scatters/gathers: GSPMD partitions them trivially along groups and
+heads, while a scatter or gather with per-slot indices risks the exact
+full-pool gather the lint gate forbids. The cost is a pool-shard
 read+write per layer per step — the honest CPU-mesh tradeoff; a Pallas
-in-place scatter kernel is the optimized path on real TPU hardware (see
-docs/tutorials/inference.md).
+paged-attention kernel with real dynamic slices is the optimized path
+on TPU hardware (see docs/tutorials/inference.md).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Tuple
+from collections import OrderedDict
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -164,6 +175,488 @@ def length_mask(lengths: jax.Array, max_len: int) -> jax.Array:
     return pos <= lengths[:, None]
 
 
+# ===================================================================== #
+# Paged layout: block pool + block-table indirection
+# ===================================================================== #
+DEAD_BLOCK = -1     # block-table entry for "unallocated" — writes through
+                    # it land nowhere and gathers through it read zeros
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedKVCacheSpec:
+    """Static geometry of the block pool: fixed at engine construction.
+
+    ``num_blocks`` is the GLOBAL pool size; it is laid out as
+    ``[num_groups, blocks_per_group]`` with the group axis sharded over
+    dp, and the allocator only hands a slot blocks from the slot's own
+    group — that locality is what keeps every block-table gather a
+    zero-communication batched contraction under GSPMD.
+    """
+    num_layers: int
+    num_slots: int
+    num_blocks: int
+    block_size: int
+    max_len: int
+    num_heads: int
+    head_dim: int
+    num_groups: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def blocks_per_group(self) -> int:
+        return self.num_blocks // self.num_groups
+
+    @property
+    def slots_per_group(self) -> int:
+        return self.num_slots // self.num_groups
+
+    @property
+    def max_blocks_per_slot(self) -> int:
+        """Block-table width J: logical blocks a full slot spans."""
+        return self.max_len // self.block_size
+
+    @property
+    def shape(self) -> Tuple[int, int, int, int, int, int]:
+        return (self.num_layers, self.num_groups, self.blocks_per_group,
+                self.num_heads, self.block_size, self.head_dim)
+
+    def nbytes(self) -> int:
+        """Total K+V pool bytes (global, unsharded)."""
+        n = 1
+        for d in self.shape:
+            n *= d
+        return 2 * n * jnp.dtype(self.dtype).itemsize
+
+    def block_nbytes(self) -> int:
+        """K+V bytes one block holds across all layers — the unit of
+        the hbm_bytes_per_token accounting."""
+        return (2 * self.num_layers * self.num_heads * self.block_size *
+                self.head_dim * jnp.dtype(self.dtype).itemsize)
+
+    def validate(self, mesh: Optional[Mesh] = None) -> None:
+        for name in ("num_layers", "num_slots", "num_blocks", "block_size",
+                     "max_len", "num_heads", "head_dim", "num_groups"):
+            if int(getattr(self, name)) <= 0:
+                raise ValueError(f"PagedKVCacheSpec.{name} must be "
+                                 f"positive, got {getattr(self, name)}")
+        if self.max_len % self.block_size:
+            raise ValueError(
+                f"inference.block_size={self.block_size} must divide the "
+                f"cache capacity ({self.max_len}) — a slot's last logical "
+                "block would otherwise overhang the position table")
+        if self.num_blocks % self.num_groups:
+            raise ValueError(
+                f"inference.num_blocks={self.num_blocks} must be divisible "
+                f"by the mesh data axis ({self.num_groups}) — blocks are "
+                "born sharded over dp alongside the slots they serve")
+        if self.num_slots % self.num_groups:
+            raise ValueError(
+                f"inference.max_slots={self.num_slots} must be divisible "
+                f"by the mesh data axis ({self.num_groups})")
+        if mesh is not None:
+            mp = int(mesh.shape.get(MP_AXIS, 1))
+            if self.num_heads % mp != 0:
+                raise ValueError(
+                    f"model heads ({self.num_heads}) not divisible by the "
+                    f"mesh model axis ({mp}) for TP head sharding")
+
+
+def paged_partition_spec() -> P:
+    """[layers, groups, blocks, heads, block_size, head_dim]: groups
+    over dp, heads over mp."""
+    return P(None, DP_AXIS, None, MP_AXIS, None, None)
+
+
+def paged_shardings(mesh: Mesh) -> Dict[str, NamedSharding]:
+    spec = paged_partition_spec()
+    return {"k": NamedSharding(mesh, spec), "v": NamedSharding(mesh, spec)}
+
+
+def init_paged_cache(spec: PagedKVCacheSpec,
+                     mesh: Optional[Mesh] = None) -> Dict[str, jax.Array]:
+    """Zero-initialized pool, born sharded when a mesh is given."""
+    spec.validate(mesh)
+
+    def make():
+        return {"k": jnp.zeros(spec.shape, spec.dtype),
+                "v": jnp.zeros(spec.shape, spec.dtype)}
+
+    if mesh is None:
+        return make()
+    return jax.jit(make, out_shardings=paged_shardings(mesh))()
+
+
+# --------------------------------------------------------------------- #
+# In-graph paged primitives. All of them are group-batched: every array
+# carries the [G, ...] group axis so GSPMD partitions over dp with zero
+# communication. ``pool`` here is ONE layer's [G, B, nH, bs, D].
+# --------------------------------------------------------------------- #
+def positions_to_blocks(bt: jax.Array, pos: jax.Array, block_size: int
+                        ) -> Tuple[jax.Array, jax.Array]:
+    """Resolve token positions through a block table.
+
+    bt: [..., J] physical block ids (DEAD_BLOCK where unallocated);
+    pos: [...] int32 token positions, same leading shape. Returns
+    (block [...], offset [...]) with block == DEAD_BLOCK for positions
+    past the table (pos >= J * block_size) or through a dead entry — a
+    write through those lands nowhere by construction.
+    """
+    J = bt.shape[-1]
+    j = pos // block_size
+    off = pos % block_size
+    jm = j[..., None] == lax.broadcasted_iota(
+        jnp.int32, j.shape + (J,), j.ndim)                   # [..., J]
+    blk = jnp.where(jm.any(-1), (jm * bt).sum(-1), DEAD_BLOCK)
+    return blk.astype(jnp.int32), off.astype(jnp.int32)
+
+
+def block_select(bt: jax.Array, blocks_per_group: int) -> jax.Array:
+    """One-hot block-table selector: bt [G, Q, J] → [G, Q, J, B] f32.
+    Dead entries (DEAD_BLOCK) select nothing."""
+    iota = lax.broadcasted_iota(jnp.int32, bt.shape + (blocks_per_group,),
+                                bt.ndim)
+    return (bt[..., None] == iota).astype(jnp.float32)
+
+
+def paged_write_rows(pool: jax.Array, new: jax.Array, blk: jax.Array,
+                     off: jax.Array) -> jax.Array:
+    """Write R rows per group into the pool at (block, offset).
+
+    pool: [G, B, nH, bs, D]; new: [G, R, nH, D]; blk/off: [G, R].
+    One-hot select over (B, bs) — the paged analogue of ``write_token``'s
+    length-axis select (see module docstring for why not scatter). Rows
+    with blk == DEAD_BLOCK write nowhere. Distinct live rows always
+    target distinct (block, offset) cells — slots never share a
+    writable block (the allocator's copy-on-write invariant) — so the
+    one-hot sum never accumulates two sources into one cell.
+    """
+    G, B = pool.shape[0], pool.shape[1]
+    bs = pool.shape[3]
+    ohb = blk[..., None] == lax.broadcasted_iota(
+        jnp.int32, blk.shape + (B,), blk.ndim)               # [G, R, B]
+    oht = off[..., None] == lax.broadcasted_iota(
+        jnp.int32, off.shape + (bs,), off.ndim)              # [G, R, bs]
+    oh = ohb[..., :, None] & oht[..., None, :]               # [G, R, B, bs]
+    vals = jnp.einsum("grbt,grnd->gbntd", oh.astype(pool.dtype),
+                      new.astype(pool.dtype))
+    mask = oh.any(1)                                         # [G, B, bs]
+    return jnp.where(mask[:, :, None, :, None], vals, pool)
+
+
+def paged_attend(q: jax.Array, pool_k: jax.Array, pool_v: jax.Array,
+                 sel: jax.Array, pos_mask: jax.Array, scale: float,
+                 neg_inf) -> jax.Array:
+    """Attention through the block table, group-batched.
+
+    q: [G, Q, K, nH, D] (Q query streams per group, K tokens each);
+    pool_k/pool_v: [G, B, nH, bs, D]; sel: [G, Q, J, B] one-hot block
+    selector; pos_mask: [G, Q, K, J*bs] bool (True = attendable).
+    Returns [G, Q, K, nH, D].
+
+    Scores contract q against the WHOLE group-local pool first
+    ([G,Q,K,nH,B,bs] fp32 — no head_dim factor, so it is the small
+    transient), then the one-hot selector picks each stream's J blocks;
+    the value combine routes the weights back through the selector. No
+    gathered K/V copy ever materializes and nothing crosses a group
+    boundary.
+    """
+    J = sel.shape[2]
+    bs = pool_k.shape[3]
+    s_all = jnp.einsum("gqknd,gbntd->gqknbt", q, pool_k
+                       ).astype(jnp.float32) * scale
+    scores = jnp.einsum("gqjb,gqknbt->gqknjt", sel, s_all)
+    G, Q, K, nH = scores.shape[:4]
+    scores = scores.reshape(G, Q, K, nH, J * bs)
+    scores = jnp.where(pos_mask[:, :, :, None, :], scores, neg_inf)
+    w = jax.nn.softmax(scores, axis=-1).reshape(G, Q, K, nH, J, bs)
+    wb = jnp.einsum("gqjb,gqknjt->gqknbt", sel, w)
+    return jnp.einsum("gqknbt,gbntd->gqknd", wb.astype(pool_v.dtype),
+                      pool_v)
+
+
+def copy_block_onehots(spec: PagedKVCacheSpec, group: int, src: int,
+                       dst: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Host-built [G, B] one-hots selecting the copy-on-write source and
+    destination blocks (local ids within ``group``)."""
+    G, B = spec.num_groups, spec.blocks_per_group
+    s = np.zeros((G, B), np.float32)
+    d = np.zeros((G, B), bool)
+    s[group, src] = 1.0
+    d[group, dst] = True
+    return s, d
+
+
+def paged_copy_block(pool: jax.Array, src_onehot: jax.Array,
+                     dst_onehot: jax.Array) -> jax.Array:
+    """Copy one block's rows to another block of the SAME group, for
+    every layer at once: the device half of copy-on-write. pool:
+    [L, G, B, nH, bs, D]; src_onehot [G, B] f32; dst_onehot [G, B]
+    bool. Groups with all-zero one-hots pass through untouched."""
+    src = jnp.einsum("gb,lgbntd->lgntd", src_onehot.astype(pool.dtype),
+                     pool)
+    return jnp.where(dst_onehot[None, :, :, None, None, None],
+                     src[:, :, None], pool)
+
+
+# --------------------------------------------------------------------- #
+# Host-side block allocator: free lists, refcounts, prefix cache, CoW
+# --------------------------------------------------------------------- #
+def chain_hash(prev: int, tokens: np.ndarray) -> int:
+    """Position-dependent hash of one full block's tokens given the
+    hash of the preceding chain — two different prefixes never collide
+    on position, only on (astronomically unlikely) hash collision."""
+    return hash((prev, tokens.astype(np.int64).tobytes()))
+
+
+class PoolExhausted(RuntimeError):
+    """No free or reclaimable block in the group — admission must be
+    rejected (the scheduler keeps the request queued; a live slot is
+    never touched)."""
+
+
+class BlockAllocator:
+    """Host-authoritative state of the block pool.
+
+    Per group (dp shard): a free list, per-block refcounts, and the
+    prefix cache — a chain-hash index over full PROMPT blocks plus an
+    LRU of retained blocks whose refcount dropped to zero (they keep
+    their bytes until pool pressure reclaims them, so a popular system
+    prompt stays resident across request lifetimes).
+
+    Admission is RESERVATION-based: ``can_admit`` checks that the
+    group can cover the request's worst-case block need (prompt +
+    max_new + spec lookahead, minus the prefix blocks it free-rides
+    on), and ``admit_prompt`` books that reservation so later lazy
+    allocations (decode appends) can never strand a live slot
+    mid-flight. Conservative next to vLLM's optimistic
+    preempt-and-recompute, and it never corrupts a running request —
+    the tradeoff docs/tutorials/inference.md spells out.
+    """
+
+    def __init__(self, spec: PagedKVCacheSpec):
+        self.spec = spec
+        G, B = spec.num_groups, spec.blocks_per_group
+        self._free: List[List[int]] = [list(range(B)) for _ in range(G)]
+        self._ref = np.zeros((G, B), np.int64)
+        # chain-hash -> local block id, per group; and its inverse for
+        # eviction bookkeeping.
+        self._hash_index: List[Dict[int, int]] = [{} for _ in range(G)]
+        self._block_hash: List[Dict[int, int]] = [{} for _ in range(G)]
+        # Retained zero-ref blocks, LRU order (oldest first).
+        self._lru: List["OrderedDict[int, None]"] = \
+            [OrderedDict() for _ in range(G)]
+        self._reserved: List[int] = [0] * G      # outstanding, per group
+        self._slot_reserved: Dict[int, int] = {}  # slot -> remaining
+        self._slot_group: Dict[int, int] = {}
+        # Cumulative telemetry the aggregator snapshots.
+        self.cow_copies = 0
+        self.reclaimed = 0
+
+    # ---- accounting ---- #
+    def blocks_in_use(self) -> int:
+        """Live (ref > 0) blocks across all groups — shared blocks count
+        once; LRU-retained blocks are reclaimable, not in use."""
+        return int((self._ref > 0).sum())
+
+    def bytes_in_use(self) -> int:
+        return self.blocks_in_use() * self.spec.block_nbytes()
+
+    def available(self, group: int) -> int:
+        """Blocks this group can still hand out: free + reclaimable
+        minus outstanding reservations."""
+        return (len(self._free[group]) + len(self._lru[group])
+                - self._reserved[group])
+
+    def need_blocks(self, prompt_len: int, max_new: int,
+                    spec_k: int = 0) -> int:
+        """Worst-case logical blocks a request spans (capped at the
+        table width)."""
+        tokens = prompt_len + max_new + spec_k
+        need = -(-tokens // self.spec.block_size)
+        return min(need, self.spec.max_blocks_per_slot)
+
+    # ---- prefix cache ---- #
+    def match_prefix(self, group: int, prompt: np.ndarray
+                     ) -> Tuple[List[int], List[int]]:
+        """Longest cached full-block chain matching ``prompt`` in this
+        group → (block ids, chain hashes). Walks the chain hash; stops
+        at the first miss."""
+        bs = self.spec.block_size
+        idx = self._hash_index[group]
+        blocks: List[int] = []
+        hashes: List[int] = []
+        h = 0
+        for j in range(len(prompt) // bs):
+            h = chain_hash(h, prompt[j * bs:(j + 1) * bs])
+            b = idx.get(h)
+            if b is None:
+                break
+            blocks.append(b)
+            hashes.append(h)
+        return blocks, hashes
+
+    def can_admit(self, group: int, prompt: np.ndarray, max_new: int,
+                  spec_k: int = 0, share: bool = True) -> bool:
+        need = self.need_blocks(len(prompt), max_new, spec_k)
+        matched = self.match_prefix(group, prompt)[0] if share else []
+        # Only LIVE shared blocks are a free ride; reviving an
+        # LRU-retained block consumes reclaimable capacity like any
+        # fresh allocation does.
+        free_ride = sum(1 for b in matched if self._ref[group, b] > 0)
+        return self.available(group) >= need - free_ride
+
+    # ---- allocation primitives ---- #
+    def _pop_block(self, group: int) -> int:
+        if self._free[group]:
+            return self._free[group].pop()
+        if self._lru[group]:
+            b, _ = self._lru[group].popitem(last=False)   # oldest
+            h = self._block_hash[group].pop(b, None)
+            if h is not None:
+                self._hash_index[group].pop(h, None)
+            self.reclaimed += 1
+            return b
+        raise PoolExhausted(
+            f"group {group}: no free or reclaimable block "
+            f"({self.spec.blocks_per_group} blocks, "
+            f"{self._reserved[group]} reserved)")
+
+    def _draw(self, group: int, slot: int) -> int:
+        """Allocate one block for ``slot``, drawing down its
+        reservation when one is booked."""
+        b = self._pop_block(group)
+        self._ref[group, b] = 1
+        if self._slot_reserved.get(slot, 0) > 0:
+            self._slot_reserved[slot] -= 1
+            self._reserved[group] -= 1
+        return b
+
+    def _incref(self, group: int, b: int) -> None:
+        if self._ref[group, b] == 0:
+            self._lru[group].pop(b, None)       # revive from retention
+        self._ref[group, b] += 1
+
+    def _decref(self, group: int, b: int) -> None:
+        self._ref[group, b] -= 1
+        assert self._ref[group, b] >= 0, "block refcount underflow"
+        if self._ref[group, b] == 0:
+            if b in self._block_hash[group]:
+                # Prefix block: retain (LRU) so the next request with
+                # this prompt still hits; reclaimed under pressure.
+                self._lru[group][b] = None
+            else:
+                self._free[group].append(b)
+
+    # ---- request lifecycle ---- #
+    def admit_prompt(self, slot: int, group: int, prompt: np.ndarray,
+                     max_new: int, spec_k: int = 0,
+                     share: bool = True) -> "AdmitPlan":
+        """Allocate/share the prompt's blocks and book the request's
+        worst-case reservation. Returns the plan the engine prefills
+        from. Raises PoolExhausted when ``can_admit`` would be False.
+        ``share=False`` (the whole-prompt prefill path, which rewrites
+        every position) opts out of the prefix cache entirely — no
+        matching, no registration."""
+        if not self.can_admit(group, prompt, max_new, spec_k,
+                              share=share):
+            raise PoolExhausted(
+                f"group {group}: {self.available(group)} block(s) "
+                f"available < worst-case need for a "
+                f"{len(prompt)}+{max_new}-token request")
+        bs = self.spec.block_size
+        plen = len(prompt)
+        matched_blocks, hashes = self.match_prefix(group, prompt) \
+            if share else ([], [])
+        # Always re-prefill at least the prompt's last token: its
+        # logits seed the first sampled token, and the block holding it
+        # must be privately writable for the decode appends that follow.
+        matched = min(len(matched_blocks) * bs, plen - 1)
+        n_keep = matched // bs                   # fully shared blocks
+        cow_src: Optional[int] = None
+        for b in matched_blocks[:n_keep]:
+            self._incref(group, b)
+        table: List[int] = list(matched_blocks[:n_keep])
+        if n_keep < len(matched_blocks):
+            # The chain covered the whole prompt; the final shared block
+            # must be written (re-prefilled last token + decode appends)
+            # → fork it copy-on-write into a private block.
+            cow_src = matched_blocks[n_keep]
+            table.append(self._draw(group, slot))
+            self.cow_copies += 1
+        # Private blocks for the unshared prompt tail.
+        while len(table) * bs < plen:
+            table.append(self._draw(group, slot))
+        # Book the rest of the worst-case need.
+        need = self.need_blocks(plen, max_new, spec_k)
+        remaining = max(0, need - len(table))
+        self._slot_reserved[slot] = remaining
+        self._slot_group[slot] = group
+        self._reserved[group] += remaining
+        # Register the prompt's full PRIVATE blocks in the prefix cache
+        # (shared ones are already registered; the CoW fork is NOT — its
+        # content diverges the moment the slot decodes into it... except
+        # it holds exactly the cached chain's tokens until then; keep it
+        # out of the index so the cached original stays authoritative).
+        h = hashes[n_keep - 1] if n_keep else 0
+        for j in range(n_keep, plen // bs) if share else ():
+            if cow_src is not None and j == n_keep:
+                h = chain_hash(h, prompt[j * bs:(j + 1) * bs])
+                continue
+            h = chain_hash(h, prompt[j * bs:(j + 1) * bs])
+            b = table[j]
+            if h not in self._hash_index[group]:
+                self._hash_index[group][h] = b
+                self._block_hash[group][b] = h
+        return AdmitPlan(slot=slot, group=group, table=table,
+                         matched=matched, cow_src=cow_src,
+                         cow_dst=table[n_keep] if cow_src is not None
+                         else None)
+
+    def alloc_block(self, slot: int) -> int:
+        """Lazily allocate one more block for a live slot (a decode or
+        verify append crossing a block boundary), drawing down the
+        slot's reservation. Raises PoolExhausted only for slots
+        admitted WITHOUT a reservation (direct engine use) on a drained
+        pool — scheduler admissions are always covered."""
+        if slot not in self._slot_group:
+            raise RuntimeError(
+                f"slot {slot} has no admitted prompt — prefill() admits "
+                "through the allocator before any decode can append")
+        return self._draw(self._slot_group[slot], slot)
+
+    def release(self, slot: int, table: Sequence[int]) -> None:
+        """Evict: drop every table reference and the unused
+        reservation. Prefix blocks whose refcount hits zero are
+        RETAINED (LRU) for future hits; private ones return to the
+        free list."""
+        group = self._slot_group.pop(slot, None)
+        if group is None:
+            return
+        rem = self._slot_reserved.pop(slot, 0)
+        self._reserved[group] -= rem
+        for b in table:
+            if b != DEAD_BLOCK:
+                self._decref(group, int(b))
+
+
+@dataclasses.dataclass
+class AdmitPlan:
+    """What ``BlockAllocator.admit_prompt`` decided: the slot's initial
+    block-table row, how many prompt tokens ride cached blocks, and the
+    copy-on-write fork to perform (device copy src → dst) if any."""
+    slot: int
+    group: int
+    table: List[int]
+    matched: int
+    cow_src: Optional[int] = None
+    cow_dst: Optional[int] = None
+
+
 __all__ = ["KVCacheSpec", "cache_partition_spec", "cache_shardings",
            "init_cache", "write_token", "write_chunk", "slot_rows",
-           "length_mask"]
+           "length_mask",
+           "DEAD_BLOCK", "PagedKVCacheSpec", "paged_partition_spec",
+           "paged_shardings", "init_paged_cache", "positions_to_blocks",
+           "block_select", "paged_write_rows", "paged_attend",
+           "copy_block_onehots", "paged_copy_block", "chain_hash",
+           "PoolExhausted", "BlockAllocator", "AdmitPlan"]

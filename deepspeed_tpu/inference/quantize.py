@@ -93,6 +93,20 @@ def dequantize(params: Any, dtype: Any = jnp.bfloat16) -> Any:
     return jax.tree_util.tree_map(deq, params, is_leaf=_is_quantized_leaf)
 
 
+def resolve_kv_dtype(mode: str, model_dtype: Any) -> Any:
+    """The ``inference.kv_cache_dtype`` knob: storage dtype of the KV
+    block pool. ``"model"`` keeps blocks at the compute dtype (bitwise
+    parity with the batch path — what the fp32 parity tests run);
+    ``"bf16"`` halves fp32 KV HBM at rest. Attention scores are fp32
+    either way, so bf16 blocks cost one rounding per written K/V row —
+    the same at-rest-vs-transient argument as int8 weights above."""
+    if mode == "model":
+        return model_dtype
+    if mode == "bf16":
+        return jnp.bfloat16
+    raise ValueError(f"unknown kv_cache_dtype mode {mode!r}")
+
+
 def quantized_bytes(params: Any) -> int:
     """At-rest bytes of a (possibly quantized) param tree."""
     total = 0
@@ -103,4 +117,5 @@ def quantized_bytes(params: Any) -> int:
 
 
 __all__ = ["quantize_params", "quantize_leaf_int8", "dequantize",
-           "quantized_bytes", "QUANT_KEY", "SCALE_KEY"]
+           "resolve_kv_dtype", "quantized_bytes", "QUANT_KEY",
+           "SCALE_KEY"]

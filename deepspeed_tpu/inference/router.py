@@ -1,0 +1,267 @@
+"""Multi-replica serving: an admission router over N InferenceEngines.
+
+The thin front end production traffic needs once one replica saturates:
+each replica is a full ``InferenceEngine`` (its own KV pool, compiled
+paths, ``ServingAggregator`` feed); the router owns the open-loop
+arrival queue and decides, per request, WHICH replica admits it —
+
+- **load**: occupancy (active slots / capacity) plus local queue depth,
+  straight from each replica's aggregator-fed counters — the router
+  never touches a device;
+- **prefix affinity**: a replica already holding the prompt's cached
+  prefix blocks scores higher, so shared prefixes land where their
+  blocks live and the paged cache's hit rate survives scale-out
+  (consistent-hashing-by-content, in effect).
+
+Replicas then run the same iteration-level continuous batching the
+single-engine scheduler runs: admit from the local queue, one
+decode/verify step for every live slot, evict finished. On real
+hardware each replica owns a disjoint mesh and the steps run in
+parallel; the CPU-mesh emulation interleaves them on one mesh, so
+per-iteration WALL times stack — tokens/s and TTFT measured here are a
+lower bound on what disjoint replicas would do (the honest-methodology
+note SERVE_BENCH.json repeats).
+
+Reports keep replicas apart: per-replica aggregator snapshots plus the
+pooled ``ServingAggregator.merged`` aggregate — never
+percentiles-of-percentiles, never one interleaved stream.
+"""
+from __future__ import annotations
+
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .scheduler import Request
+from ..monitor.serving import ServingAggregator
+
+
+class ReplicaRouter:
+    """Route + serve an open-loop stream over N engine replicas."""
+
+    def __init__(self, engines: Sequence[Any], temperature: float = 0.0,
+                 eos_token: Optional[int] = None,
+                 affinity_weight: float = 1.0,
+                 idle_sleep_s: float = 0.0005,
+                 max_wall_s: Optional[float] = None):
+        if not engines:
+            raise ValueError("ReplicaRouter needs at least one engine")
+        self.engines = list(engines)
+        self.temperature = float(temperature)
+        self.eos_token = eos_token
+        self.affinity_weight = float(affinity_weight)
+        self.idle_sleep_s = float(idle_sleep_s)
+        self.max_wall_s = max_wall_s
+        self.routed: List[int] = [0] * len(self.engines)
+        self.affinity_hits = 0
+
+    # ------------------------------------------------------------------ #
+    def _score(self, eng, queue_len: int, req: Request) -> float:
+        """Higher is better: prefix affinity minus load (occupancy +
+        normalized queue depth)."""
+        plen = max(len(req.prompt), 1)
+        affinity = eng.prefix_match_tokens(req.prompt) / plen
+        load = (eng.active_slots / eng.max_slots
+                + queue_len / eng.max_slots)
+        return self.affinity_weight * affinity - load
+
+    def route(self, req: Request, queues: List[deque]) -> int:
+        """Pick the admitting replica for one request (called once, at
+        arrival — affinity is sticky by construction afterwards)."""
+        scores = [self._score(eng, len(queues[i]), req)
+                  for i, eng in enumerate(self.engines)]
+        best = int(np.argmax(scores))
+        plain = [-(eng.active_slots / eng.max_slots
+                   + len(queues[i]) / eng.max_slots)
+                 for i, eng in enumerate(self.engines)]
+        if best != int(np.argmax(plain)):
+            self.affinity_hits += 1     # affinity overrode pure load
+        self.routed[best] += 1
+        return best
+
+    # ------------------------------------------------------------------ #
+    def serve(self, requests: Sequence[Request]) -> Dict[str, Any]:
+        """Run the stream to completion across all replicas; returns the
+        multi-replica report: pooled aggregate + per-replica snapshots +
+        per-request records (each naming its replica)."""
+        n_rep = len(self.engines)
+        t0 = time.perf_counter()
+        pending = deque(sorted(requests, key=lambda r: r.arrival_s))
+        queues: List[deque] = [deque() for _ in range(n_rep)]
+        active: List[Dict[int, Request]] = [{} for _ in range(n_rep)]
+        replica_of: Dict[int, int] = {}
+        spec = [bool(getattr(e, "spec_enabled", False))
+                and self.temperature == 0.0 for e in self.engines]
+
+        def finished(req: Request, eng, slot: int) -> bool:
+            if len(req.out_tokens) >= req.max_new_tokens:
+                return True
+            if self.eos_token is not None and req.out_tokens and \
+                    req.out_tokens[-1] == self.eos_token:
+                return True
+            return eng.context_len(slot) >= eng.max_len
+
+        def complete(req: Request, eng) -> None:
+            eng.complete_request(req.rid, req.ttft_s or 0.0, req.tpot_s,
+                                 prompt_tokens=len(req.prompt),
+                                 new_tokens=len(req.out_tokens))
+
+        while pending or any(queues) or any(active):
+            now = time.perf_counter() - t0
+            if self.max_wall_s is not None and now > self.max_wall_s:
+                for i, eng in enumerate(self.engines):
+                    for slot in list(active[i]):
+                        eng.release_slot(slot)
+                        del active[i][slot]
+                break
+            # 1. arrivals route to a replica queue immediately.
+            while pending and pending[0].arrival_s <= now:
+                req = pending.popleft()
+                req.t_arrival = t0 + req.arrival_s
+                i = self.route(req, queues)
+                replica_of[req.rid] = i
+                queues[i].append(req)
+            stepped = False
+            for i, eng in enumerate(self.engines):
+                # 2. per-replica admissions (FCFS within the replica) —
+                # batched one-slot-per-group when the engine pages.
+                batched = getattr(eng, "paged", False) and \
+                    eng.prefill_chunk > 0
+                while queues[i]:
+                    batch = []
+                    used: set = set()
+                    while queues[i]:
+                        req = queues[i][0]
+                        slot = eng.select_slot(
+                            req.prompt, req.max_new_tokens,
+                            exclude_groups=used if batched else None)
+                        if slot is None:
+                            break
+                        queues[i].popleft()
+                        used.add(eng.group_of(slot))
+                        batch.append((req, slot))
+                        if not batched:
+                            break
+                    if not batch:
+                        break
+                    t_now = time.perf_counter()
+                    if batched:
+                        with eng.telemetry.span(
+                                "prefill", slots=len(batch),
+                                tokens=sum(len(r.prompt)
+                                           for r, _ in batch)):
+                            results = eng.prefill_many(
+                                [(slot, req.prompt, req.max_new_tokens)
+                                 for req, slot in batch],
+                                self.temperature)
+                        t_now = time.perf_counter()
+                    else:
+                        results = []
+                        for req, slot in batch:
+                            with eng.telemetry.span(
+                                    "prefill", slot=slot,
+                                    tokens=len(req.prompt)):
+                                results.append(eng.prefill(
+                                    req.prompt, slot, self.temperature,
+                                    max_new_tokens=req.max_new_tokens))
+                        t_now = time.perf_counter()
+                    for (req, slot), (tok, _) in zip(batch, results):
+                        req.slot = slot
+                        req.t_first = req.t_last = t_now
+                        req.out_tokens = [tok]
+                        eng.activate_slot(slot, len(req.prompt), tok)
+                        eng.serving.note_prefill(len(req.prompt))
+                        if finished(req, eng, slot):
+                            complete(req, eng)
+                            eng.release_slot(slot)
+                        else:
+                            active[i][slot] = req
+                # 3. one iteration for this replica's live slots.
+                if not active[i]:
+                    continue
+                stepped = True
+                if spec[i]:
+                    emitted, n_new = eng.spec_decode_once(
+                        self.temperature)
+                    t_now = time.perf_counter()
+                    for slot in list(active[i]):
+                        req = active[i][slot]
+                        budget = req.max_new_tokens - len(req.out_tokens)
+                        toks = [int(t) for t in
+                                emitted[slot, :int(n_new[slot])]]
+                        if self.eos_token is not None and \
+                                self.eos_token in toks:
+                            toks = toks[:toks.index(self.eos_token) + 1]
+                        req.out_tokens.extend(toks[:max(budget, 0)])
+                        req.t_last = t_now
+                        if finished(req, eng, slot):
+                            complete(req, eng)
+                            eng.release_slot(slot)
+                            del active[i][slot]
+                else:
+                    sampled, _ = eng.decode_once(self.temperature)
+                    t_now = time.perf_counter()
+                    for slot in list(active[i]):
+                        req = active[i][slot]
+                        req.out_tokens.append(int(sampled[slot]))
+                        req.t_last = t_now
+                        if finished(req, eng, slot):
+                            complete(req, eng)
+                            eng.release_slot(slot)
+                            del active[i][slot]
+            if not stepped and (pending or any(queues)):
+                # Same loud-failure rule as the single-engine
+                # scheduler: when every replica is idle, nothing is in
+                # flight, and no future arrival can change the picture,
+                # a queued head that still cannot admit NEVER will
+                # (its worst-case block need exceeds its replica's
+                # per-group pool) — raise instead of spinning.
+                if not pending and not any(active) and \
+                        not any(e.active.any() for e in self.engines):
+                    req = next(q[0] for q in queues if q)
+                    raise RuntimeError(
+                        f"request {req.rid} can never be admitted on "
+                        f"its routed replica: {len(req.prompt)} prompt "
+                        f"+ {req.max_new_tokens} new tokens exceeds "
+                        "the block pool's per-group capacity")
+                for eng in self.engines:
+                    eng.telemetry.heartbeat()
+                time.sleep(self.idle_sleep_s)
+
+        wall = time.perf_counter() - t0
+        per_replica = []
+        for eng in self.engines:
+            if eng.telemetry.enabled:
+                eng.telemetry.drain({"serving": eng.serving.snapshot(
+                    wall_s=wall)})
+            per_replica.append(eng.serving.snapshot(wall_s=wall))
+        merged = ServingAggregator.merged(
+            [e.serving for e in self.engines])
+        report = dict(merged.snapshot(wall_s=wall))
+        report["recompiles"] = sum(e.telemetry.recompile_count
+                                   for e in self.engines)
+        report["unfinished"] = len(pending) + sum(map(len, queues)) + \
+            sum(map(len, active))
+        report["replicas"] = per_replica
+        report["router"] = {
+            "replicas": len(self.engines),
+            "routed": list(self.routed),
+            "affinity_overrides": self.affinity_hits,
+            "affinity_weight": self.affinity_weight,
+        }
+        report["requests"] = [
+            {"rid": r.rid, "replica": replica_of.get(r.rid),
+             "prompt_tokens": len(r.prompt),
+             "new_tokens": len(r.out_tokens),
+             "ttft_ms": round(r.ttft_s * 1e3, 3)
+             if r.ttft_s is not None else None,
+             "tpot_ms": round(r.tpot_s * 1e3, 3)
+             if r.tpot_s is not None else None,
+             "tokens": list(map(int, r.out_tokens))}
+            for r in sorted(requests, key=lambda r: r.rid)]
+        return report
+
+
+__all__ = ["ReplicaRouter"]

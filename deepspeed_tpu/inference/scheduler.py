@@ -77,6 +77,34 @@ def synthetic_requests(n: int, prompt_len: Tuple[int, int] = (8, 16),
     return out
 
 
+def shared_prefix_requests(n: int, prefix_len: int = 32,
+                           tail_len: Tuple[int, int] = (4, 12),
+                           max_new_tokens: int = 16,
+                           rate_rps: float = 0.0, vocab_size: int = 512,
+                           seed: int = 0) -> List[Request]:
+    """The shared-prefix open-loop workload: every request carries the
+    SAME ``prefix_len``-token system prompt followed by a random tail
+    in ``tail_len`` (inclusive) — the traffic shape prefix-shared
+    paging is built for (common system prompts / few-shot preambles,
+    varying user turns). Arrival process as in
+    ``synthetic_requests``."""
+    rng = np.random.default_rng(seed)
+    prefix = rng.integers(0, vocab_size, size=prefix_len).astype(np.int32)
+    t = 0.0
+    out = []
+    lo, hi = tail_len
+    for i in range(n):
+        if rate_rps > 0 and i > 0:
+            t += float(rng.exponential(1.0 / rate_rps))
+        tail = rng.integers(0, vocab_size,
+                            size=int(rng.integers(lo, hi + 1))
+                            ).astype(np.int32)
+        out.append(Request(rid=i,
+                           prompt=np.concatenate([prefix, tail]),
+                           max_new_tokens=max_new_tokens, arrival_s=t))
+    return out
+
+
 class ContinuousBatchingScheduler:
     """Per-iteration insert/evict over an InferenceEngine's slots."""
 
@@ -115,8 +143,25 @@ class ContinuousBatchingScheduler:
         pending = deque(sorted(requests, key=lambda r: r.arrival_s))
         queue: deque = deque()
         active: Dict[int, Request] = {}
-        free: deque = deque(i for i in range(eng.max_slots)
-                            if not eng.active[i])
+        # Engines with a block pool own slot selection (prefix-affinity
+        # group choice + HBM admission gate); the scheduler keeps its
+        # own free list for engines that predate it (slot-major, test
+        # fakes) — slot occupancy is then the whole gate.
+        select = getattr(eng, "select_slot", None)
+        free: deque = deque(() if select else
+                            (i for i in range(eng.max_slots)
+                             if not eng.active[i]))
+        # Speculative decoding emits 1..k+1 tokens per slot per
+        # iteration; greedy only — exact rejection sampling for
+        # temperature > 0 is not implemented, so sampling streams fall
+        # back to plain decode.
+        spec = bool(getattr(eng, "spec_enabled", False)) and \
+            self.temperature == 0.0
+
+        def _release(slot):
+            eng.release_slot(slot)
+            if not select:
+                free.append(slot)
 
         while pending or queue or active:
             now = time.perf_counter() - t0
@@ -125,8 +170,7 @@ class ContinuousBatchingScheduler:
                 # slots must come back, or the engine's next serve()
                 # starts with no free slots and spins forever.
                 for slot in list(active):
-                    self.engine.release_slot(slot)
-                    free.append(slot)
+                    _release(slot)
                     del active[slot]
                 break
             # 1. open-loop arrivals join the queue on schedule.
@@ -134,14 +178,65 @@ class ContinuousBatchingScheduler:
                 req = pending.popleft()
                 req.t_arrival = t0 + req.arrival_s
                 queue.append(req)
-            # 2. admissions: prefill into free slots.
-            while queue and free:
-                req = queue.popleft()
-                slot = free.popleft()
+            # 2. admissions: prefill into free slots. FCFS — when the
+            # head of the queue cannot be admitted (no slot, or the
+            # block pool cannot cover its worst case), everything
+            # behind it waits; pool exhaustion rejects admission here
+            # and NEVER touches a live slot. Paged engines admit in
+            # one-slot-per-group BATCHES (engine.prefill_many): a full
+            # batch prefills G admissions for one admission's wall.
+            batched = select is not None and \
+                getattr(eng, "paged", False) and eng.prefill_chunk > 0
+            while queue:
+                if batched:
+                    batch = []
+                    used: set = set()
+                    while queue:
+                        req = queue[0]
+                        slot = select(req.prompt, req.max_new_tokens,
+                                      exclude_groups=used)
+                        if slot is None:
+                            break
+                        queue.popleft()
+                        used.add(eng.group_of(slot))
+                        batch.append((req, slot))
+                    if not batch:
+                        break
+                    with eng.telemetry.span(
+                            "prefill", slots=len(batch),
+                            tokens=sum(len(r.prompt)
+                                       for r, _ in batch)):
+                        results = eng.prefill_many(
+                            [(slot, req.prompt, req.max_new_tokens)
+                             for req, slot in batch], self.temperature)
+                    t_now = time.perf_counter()
+                    for (req, slot), (tok, _) in zip(batch, results):
+                        req.slot = slot
+                        req.t_first = req.t_last = t_now
+                        req.out_tokens = [tok]
+                        eng.activate_slot(slot, len(req.prompt), tok)
+                        eng.serving.note_prefill(len(req.prompt))
+                        if self._finished(req, eng.context_len(slot)):
+                            self._complete(req)
+                            _release(slot)
+                        else:
+                            active[slot] = req
+                    continue
+                req = queue[0]
+                if select is not None:
+                    slot = select(req.prompt, req.max_new_tokens)
+                    if slot is None:
+                        break
+                elif free:
+                    slot = free.popleft()
+                else:
+                    break
+                queue.popleft()
                 with eng.telemetry.span("prefill", slot=slot,
                                         tokens=len(req.prompt)):
-                    tok, _ = eng.prefill(req.prompt, slot,
-                                         self.temperature)
+                    tok, _ = eng.prefill(
+                        req.prompt, slot, self.temperature,
+                        max_new_tokens=req.max_new_tokens)
                 req.slot = slot
                 req.t_first = req.t_last = time.perf_counter()
                 req.out_tokens = [tok]
@@ -149,12 +244,29 @@ class ContinuousBatchingScheduler:
                 eng.serving.note_prefill(len(req.prompt))
                 if self._finished(req, eng.context_len(slot)):
                     self._complete(req)
-                    eng.release_slot(slot)
-                    free.append(slot)
+                    _release(slot)
                 else:
                     active[slot] = req
-            # 3. one decode iteration for every live slot.
-            if active:
+            # 3. one decode (or draft-then-verify) iteration for every
+            # live slot.
+            if active and spec:
+                emitted, n_new = eng.spec_decode_once(self.temperature)
+                t_now = time.perf_counter()
+                for slot in list(active):
+                    req = active[slot]
+                    budget = req.max_new_tokens - len(req.out_tokens)
+                    n = int(n_new[slot])
+                    toks = [int(t) for t in emitted[slot, :n]]
+                    if self.eos_token is not None and \
+                            self.eos_token in toks:
+                        toks = toks[:toks.index(self.eos_token) + 1]
+                    req.out_tokens.extend(toks[:max(budget, 0)])
+                    req.t_last = t_now
+                    if self._finished(req, eng.context_len(slot)):
+                        self._complete(req)
+                        _release(slot)
+                        del active[slot]
+            elif active:
                 sampled, _ = eng.decode_once(self.temperature)
                 t_now = time.perf_counter()
                 for slot in list(active):
@@ -163,8 +275,7 @@ class ContinuousBatchingScheduler:
                     req.t_last = t_now
                     if self._finished(req, eng.context_len(slot)):
                         self._complete(req)
-                        eng.release_slot(slot)
-                        free.append(slot)
+                        _release(slot)
                         del active[slot]
             elif pending and not queue:
                 # Idle ahead of the next arrival — open-loop wait. The
@@ -177,7 +288,18 @@ class ContinuousBatchingScheduler:
             elif queue:
                 # Queued work but no free slot and nothing decoding:
                 # capacity is held outside this serve (caller-activated
-                # slots). Yield instead of busy-spinning.
+                # slots). Yield instead of busy-spinning — unless
+                # nothing can EVER free the capacity the head request
+                # needs (an over-sized request on an idle engine), which
+                # must fail loudly, not hang.
+                if select is not None and not active and not pending \
+                        and not eng.active.any():
+                    req = queue[0]
+                    raise RuntimeError(
+                        f"request {req.rid} can never be admitted: "
+                        f"{len(req.prompt)} prompt + "
+                        f"{req.max_new_tokens} new tokens exceeds the "
+                        "block pool's per-group capacity")
                 eng.telemetry.heartbeat()
                 time.sleep(self.idle_sleep_s)
 
@@ -207,4 +329,5 @@ class ContinuousBatchingScheduler:
         return report
 
 
-__all__ = ["Request", "synthetic_requests", "ContinuousBatchingScheduler"]
+__all__ = ["Request", "synthetic_requests", "shared_prefix_requests",
+           "ContinuousBatchingScheduler"]

@@ -37,29 +37,69 @@ def _pcts(vals: List[float]) -> Dict[str, float]:
 
 
 class ServingAggregator:
-    """Accumulates per-iteration and per-request serving metrics."""
+    """Accumulates per-iteration and per-request serving metrics.
 
-    def __init__(self, max_slots: int):
+    ``label`` names the replica this aggregator feeds (the multi-
+    replica router runs one engine — and one aggregator — per replica);
+    snapshots carry it so downstream consumers (telemetry_report,
+    SERVE_BENCH.json) never interleave two replicas' percentile streams
+    into one misleading distribution. ``ServingAggregator.merged``
+    builds the honest aggregate view by POOLING the raw samples.
+    """
+
+    def __init__(self, max_slots: int, label: Optional[str] = None):
         self.max_slots = max(1, int(max_slots))
+        self.label = label
         self.t0 = time.perf_counter()
         self.iterations = 0
         self.decode_tokens = 0
         self.prefill_tokens = 0
         self.completed = 0
+        # Paged-cache accounting (engine-fed; stays empty — and out of
+        # the snapshot — on slot-major engines that predate it).
+        self.prompt_tokens_admitted = 0
+        self.cached_tokens_admitted = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
         self._occupancy: List[float] = []
         self._decode_ms: List[float] = []
         self._ttft_ms: List[float] = []
         self._tpot_ms: List[float] = []
+        self._hbm_per_token: List[float] = []
+        self._cache_bytes: List[int] = []
 
     # ---- per decode iteration ---- #
-    def note_iteration(self, active_slots: int, decode_s: float) -> None:
+    def note_iteration(self, active_slots: int, decode_s: float,
+                       cache_bytes: Optional[int] = None,
+                       context_tokens: Optional[int] = None,
+                       emitted_tokens: Optional[int] = None) -> None:
+        """``emitted_tokens`` defaults to one per active slot (plain
+        decode); the speculative verify step passes the real count.
+        ``cache_bytes`` / ``context_tokens`` sample the HBM the cache
+        holds against the tokens it serves — the hbm_bytes_per_token
+        series the paging win is measured on."""
         self.iterations += 1
-        self.decode_tokens += int(active_slots)
+        self.decode_tokens += int(emitted_tokens
+                                  if emitted_tokens is not None
+                                  else active_slots)
         self._occupancy.append(active_slots / self.max_slots)
         self._decode_ms.append(decode_s * 1e3)
+        if cache_bytes is not None and context_tokens:
+            self._cache_bytes.append(int(cache_bytes))
+            self._hbm_per_token.append(cache_bytes / context_tokens)
 
     def note_prefill(self, prompt_tokens: int) -> None:
         self.prefill_tokens += int(prompt_tokens)
+
+    def note_admit(self, prompt_tokens: int, cached_tokens: int) -> None:
+        """Prefix-cache accounting at admission: how many of the
+        prompt's tokens rode already-resident blocks."""
+        self.prompt_tokens_admitted += int(prompt_tokens)
+        self.cached_tokens_admitted += int(cached_tokens)
+
+    def note_spec(self, proposed: int, accepted: int) -> None:
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
 
     # ---- per completed request ---- #
     def note_request(self, ttft_s: float, tpot_s: Optional[float],
@@ -78,10 +118,13 @@ class ServingAggregator:
     def snapshot(self, wall_s: Optional[float] = None) -> Dict[str, Any]:
         """The canonical serving summary. ``tokens_per_s`` counts
         GENERATED (decode) tokens over the serve wall — prefill tokens
-        are reported separately, not inflated into throughput."""
+        are reported separately, not inflated into throughput. Fields
+        the engine never fed (no paged cache, no spec decode) are
+        omitted so pre-paging consumers and the bench gate's
+        skip-never-fail rule keep working."""
         wall = wall_s if wall_s is not None \
             else time.perf_counter() - self.t0
-        return {
+        snap = {
             "iterations": self.iterations,
             "completed": self.completed,
             "occupancy_mean": round(self.occupancy_mean, 4),
@@ -96,6 +139,53 @@ class ServingAggregator:
             "tpot_ms": _pcts(self._tpot_ms),
             "decode_step_ms": _pcts(self._decode_ms),
         }
+        if self.label is not None:
+            snap["replica"] = self.label
+        if self._hbm_per_token:
+            snap["hbm_bytes_per_token"] = _pcts(self._hbm_per_token)
+            snap["cache_bytes_p95"] = int(percentile(
+                sorted(self._cache_bytes), 95))
+        if self.prompt_tokens_admitted:
+            snap["prefix"] = {
+                "prompt_tokens": self.prompt_tokens_admitted,
+                "cached_tokens": self.cached_tokens_admitted,
+                "hit_rate": round(self.cached_tokens_admitted /
+                                  self.prompt_tokens_admitted, 4),
+            }
+        if self.spec_proposed:
+            snap["spec"] = {
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": round(self.spec_accepted /
+                                         self.spec_proposed, 4),
+            }
+        return snap
+
+    @classmethod
+    def merged(cls, aggs: List["ServingAggregator"],
+               label: str = "aggregate") -> "ServingAggregator":
+        """The honest aggregate over replicas: raw samples POOLED, not
+        percentiles-of-percentiles, counters summed, capacity summed."""
+        out = cls(sum(a.max_slots for a in aggs) or 1, label=label)
+        for a in aggs:
+            out.iterations += a.iterations
+            out.decode_tokens += a.decode_tokens
+            out.prefill_tokens += a.prefill_tokens
+            out.completed += a.completed
+            out.prompt_tokens_admitted += a.prompt_tokens_admitted
+            out.cached_tokens_admitted += a.cached_tokens_admitted
+            out.spec_proposed += a.spec_proposed
+            out.spec_accepted += a.spec_accepted
+            # Occupancy normalizes per-replica (active/its own slots):
+            # pooling the normalized samples keeps the mean meaningful
+            # as "fraction of owned capacity busy".
+            out._occupancy.extend(a._occupancy)
+            out._decode_ms.extend(a._decode_ms)
+            out._ttft_ms.extend(a._ttft_ms)
+            out._tpot_ms.extend(a._tpot_ms)
+            out._hbm_per_token.extend(a._hbm_per_token)
+            out._cache_bytes.extend(a._cache_bytes)
+        return out
 
 
 __all__ = ["ServingAggregator", "percentile"]

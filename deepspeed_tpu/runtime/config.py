@@ -255,6 +255,17 @@ class InferenceConfig:
                             C.INFERENCE_QUANTIZE_DEFAULT)
         self.prefill_chunk = get(d, C.INFERENCE_PREFILL_CHUNK,
                                  C.INFERENCE_PREFILL_CHUNK_DEFAULT)
+        self.block_size = get(d, C.INFERENCE_BLOCK_SIZE,
+                              C.INFERENCE_BLOCK_SIZE_DEFAULT)
+        self.num_blocks = get(d, C.INFERENCE_NUM_BLOCKS,
+                              C.INFERENCE_NUM_BLOCKS_DEFAULT)
+        self.spec_k = get(d, C.INFERENCE_SPEC_K, C.INFERENCE_SPEC_K_DEFAULT)
+        self.spec_ngram = get(d, C.INFERENCE_SPEC_NGRAM,
+                              C.INFERENCE_SPEC_NGRAM_DEFAULT)
+        self.kv_cache_dtype = get(d, C.INFERENCE_KV_DTYPE,
+                                  C.INFERENCE_KV_DTYPE_DEFAULT)
+        self.replica = get(d, C.INFERENCE_REPLICA,
+                           C.INFERENCE_REPLICA_DEFAULT)
         self._validate()
 
     def _validate(self) -> None:
@@ -276,6 +287,39 @@ class InferenceConfig:
                 f"{C.INFERENCE}.{C.INFERENCE_PREFILL_CHUNK} must be a "
                 f"non-negative int (0 = whole-prompt prefill), got "
                 f"{self.prefill_chunk!r}")
+        if not isinstance(self.block_size, int) or self.block_size < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_BLOCK_SIZE} must be a "
+                f"non-negative int (0 = slot-major layout), got "
+                f"{self.block_size!r}")
+        if not isinstance(self.num_blocks, int) or self.num_blocks < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_NUM_BLOCKS} must be a "
+                f"non-negative int (0 = full provisioning), got "
+                f"{self.num_blocks!r}")
+        if not isinstance(self.spec_k, int) or self.spec_k < 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPEC_K} must be a "
+                f"non-negative int (0 = speculative decoding off), got "
+                f"{self.spec_k!r}")
+        if self.spec_k > 0 and self.block_size == 0:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPEC_K} requires the paged "
+                f"cache ({C.INFERENCE_BLOCK_SIZE} > 0) — the verify step "
+                "writes draft K/V through the block table")
+        if not isinstance(self.spec_ngram, int) or self.spec_ngram < 1:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SPEC_NGRAM} must be a "
+                f"positive int, got {self.spec_ngram!r}")
+        if self.kv_cache_dtype not in C.INFERENCE_KV_DTYPE_MODES:
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_KV_DTYPE} must be one of "
+                f"{C.INFERENCE_KV_DTYPE_MODES}, got "
+                f"{self.kv_cache_dtype!r}")
+        if not isinstance(self.replica, str):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_REPLICA} must be a string "
+                f"label, got {self.replica!r}")
 
 
 class MeshConfig:

@@ -394,7 +394,8 @@ class TestBenchGate:
         bg = load_bench_gate()
         none_srv = {"serve_tps": None, "ttft_p95": None,
                     "kernel_speedup": None, "zero3_overlap": None,
-                    "health": None}
+                    "health": None, "hbm_per_token": None,
+                    "accept_rate": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}
@@ -430,6 +431,57 @@ class TestBenchGate:
         assert bg.main([old, slow]) == 1
         assert bg.main([old, laggy]) == 1
         assert bg.main([pre, old]) == 0        # pre-serving round skips
+
+    def test_extract_paged_serving_fields(self):
+        bg = load_bench_gate()
+        m = bg.extract_metrics({"serving": {
+            "tokens_per_s": 900.0,
+            "ttft_ms": {"p95": 50.0},
+            "hbm_bytes_per_token": {"p50": 1200.0, "p95": 1400.0},
+            "spec": {"proposed": 100, "accepted": 80,
+                     "acceptance_rate": 0.8}}})
+        assert m["hbm_per_token"] == 1200.0
+        assert m["accept_rate"] == 0.8
+        # Slot-major serving record: paged fields absent -> None.
+        m = bg.extract_metrics({"serving": {"tokens_per_s": 50.0}})
+        assert m["hbm_per_token"] is None and m["accept_rate"] is None
+
+    def test_gate_hbm_bytes_per_token(self, tmp_path):
+        """HBM/token regresses on a RISE; pre-paging rounds skip."""
+        bg = load_bench_gate()
+        old = self._write(tmp_path, "old.json", {"serving": {
+            "hbm_bytes_per_token": {"p50": 1000.0}}})
+        ok = self._write(tmp_path, "ok.json", {"serving": {
+            "hbm_bytes_per_token": {"p50": 1100.0}}})
+        fat = self._write(tmp_path, "fat.json", {"serving": {
+            "hbm_bytes_per_token": {"p50": 1300.0}}})
+        pre = self._write(tmp_path, "pre.json", {"serving": {
+            "tokens_per_s": 50.0}})
+        assert bg.main([old, ok]) == 0
+        assert bg.main([old, fat]) == 1
+        assert bg.main([old, fat, "--hbm-rise", "0.5"]) == 0
+        assert bg.main([pre, old]) == 0        # pre-paging round skips
+        assert bg.main([old, pre]) == 0
+
+    def test_gate_spec_acceptance(self, tmp_path):
+        """Acceptance gates on the new-side floor and on a relative
+        drop vs the previous round; pre-spec rounds skip."""
+        bg = load_bench_gate()
+
+        def srv(rate):
+            return {"serving": {"spec": {"acceptance_rate": rate}}}
+
+        old = self._write(tmp_path, "old.json", srv(0.8))
+        ok = self._write(tmp_path, "ok.json", srv(0.75))
+        collapsed = self._write(tmp_path, "collapsed.json", srv(0.02))
+        dropped = self._write(tmp_path, "dropped.json", srv(0.5))
+        pre = self._write(tmp_path, "pre.json", {"serving": {
+            "tokens_per_s": 50.0}})
+        assert bg.main([old, ok]) == 0
+        assert bg.main([old, collapsed]) == 1      # under the floor
+        assert bg.main([old, dropped]) == 1        # >10% rel drop
+        assert bg.main([pre, ok]) == 0             # floor-only check
+        assert bg.main([old, pre]) == 0            # pre-spec skips
 
     def test_gate_passes_within_threshold(self, tmp_path):
         bg = load_bench_gate()

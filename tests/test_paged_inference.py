@@ -1,0 +1,589 @@
+"""Paged prefix-shared KV cache + speculative decoding + multi-replica
+routing (PR-12 tentpole).
+
+The load-bearing invariants:
+
+1. **Parity** — block-table decode produces the same logits as the PR-7
+   slot-major decode (and as the full batch forward) at fp32 tolerance;
+   prefix-shared admissions see bit-identical prefill logits.
+2. **Bit-identity** — speculative greedy decode emits exactly the same
+   token streams as non-speculative greedy decode (the acceptance-rule
+   guarantee), whatever the n-gram drafter proposes.
+3. **Safety** — pool exhaustion rejects admission and never corrupts a
+   live slot; copy-on-write forks before the first divergent write;
+   refcounts return blocks on evict (with LRU retention for prefix
+   blocks).
+4. **Static shapes** — the paged serve (decode, batched chunk prefill,
+   verify, block copy) runs under ``fail_on_recompile`` with zero
+   post-warmup retraces.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import (InferenceEngine, NGramDrafter,
+                                     PagedKVCacheSpec, PoolExhausted,
+                                     ReplicaRouter,
+                                     shared_prefix_requests,
+                                     synthetic_requests)
+from deepspeed_tpu.inference import kv_cache
+from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_apply, gpt2_init
+from deepspeed_tpu.monitor.serving import ServingAggregator
+
+CFG32 = dataclasses.replace(GPT2_CONFIGS["gpt2-tiny"], dtype=jnp.float32)
+CFG = GPT2_CONFIGS["gpt2-tiny"]
+
+
+@pytest.fixture(scope="module")
+def params32():
+    return gpt2_init(jax.random.PRNGKey(0), CFG32)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return gpt2_init(jax.random.PRNGKey(1), CFG)
+
+
+def _prompt(n, seed=0, vocab=None):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, vocab or CFG32.vocab_size,
+                        size=n).astype(np.int32)
+
+
+def _engine(params, *, paged=True, slots=8, max_len=64, chunk=8,
+            block_size=16, num_blocks=0, spec_k=0, cfg=CFG32, **tel):
+    config = {"inference": {"max_slots": slots, "max_seq_len": max_len,
+                            "prefill_chunk": chunk,
+                            "block_size": block_size if paged else 0,
+                            "num_blocks": num_blocks,
+                            "spec_k": spec_k}}
+    config.update(tel)
+    return InferenceEngine(cfg, params, config=config)
+
+
+# --------------------------------------------------------------------- #
+# Paged primitives (device units)
+# --------------------------------------------------------------------- #
+class TestPagedPrimitives:
+    def test_positions_to_blocks_resolves_and_deadens(self):
+        bt = jnp.asarray([[3, 7, kv_cache.DEAD_BLOCK]], jnp.int32)
+        pos = jnp.asarray([[0, 5, 9, 11, 13]], jnp.int32)   # bs=4, J=3
+        bt_rows = jnp.broadcast_to(bt[:, None, :], (1, 5, 3))
+        blk, off = kv_cache.positions_to_blocks(bt_rows[0], pos[0], 4)
+        assert blk.tolist() == [3, 7, kv_cache.DEAD_BLOCK,
+                                kv_cache.DEAD_BLOCK, kv_cache.DEAD_BLOCK]
+        assert off.tolist() == [0, 1, 1, 3, 1]
+        # Past the table entirely (pos // bs >= J) is dead too.
+        blk2, _ = kv_cache.positions_to_blocks(
+            jnp.asarray([5, 6, 7], jnp.int32), jnp.int32(13), 4)
+        assert int(blk2) == kv_cache.DEAD_BLOCK
+
+    def test_paged_write_rows_lands_and_dead_rows_dont(self):
+        pool = jnp.zeros((2, 4, 2, 4, 3), jnp.float32)  # [G,B,nH,bs,D]
+        new = jnp.ones((2, 2, 2, 3), jnp.float32) * \
+            jnp.asarray([1.0, 2.0])[None, :, None, None]
+        blk = jnp.asarray([[1, kv_cache.DEAD_BLOCK], [3, 0]], jnp.int32)
+        off = jnp.asarray([[2, 0], [0, 3]], jnp.int32)
+        out = np.array(kv_cache.paged_write_rows(pool, new, blk, off))
+        assert (out[0, 1, :, 2] == 1.0).all()       # row 0 of group 0
+        assert (out[1, 3, :, 0] == 1.0).all()       # row 0 of group 1
+        assert (out[1, 0, :, 3] == 2.0).all()       # row 1 of group 1
+        # Dead row wrote nowhere; everything else untouched.
+        out[0, 1, :, 2] = 0
+        out[1, 3, :, 0] = 0
+        out[1, 0, :, 3] = 0
+        assert (out == 0).all()
+
+    def test_copy_block_copies_one_group_only(self):
+        pool = jnp.arange(2 * 2 * 3 * 1 * 2 * 2, dtype=jnp.float32
+                          ).reshape(2, 2, 3, 1, 2, 2)  # [L,G,B,nH,bs,D]
+        spec = PagedKVCacheSpec(num_layers=2, num_slots=2, num_blocks=6,
+                                block_size=2, max_len=4, num_heads=1,
+                                head_dim=2, num_groups=2,
+                                dtype=jnp.float32)
+        src, dst = kv_cache.copy_block_onehots(spec, group=1, src=0,
+                                               dst=2)
+        out = np.array(kv_cache.paged_copy_block(pool, jnp.asarray(src),
+                                                 jnp.asarray(dst)))
+        ref = np.asarray(pool)
+        np.testing.assert_array_equal(out[:, 1, 2], ref[:, 1, 0])
+        out[:, 1, 2] = ref[:, 1, 2]
+        np.testing.assert_array_equal(out, ref)
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match="divide"):
+            PagedKVCacheSpec(num_layers=1, num_slots=4, num_blocks=8,
+                             block_size=3, max_len=8, num_heads=2,
+                             head_dim=4).validate()
+        with pytest.raises(ValueError, match="divisible"):
+            PagedKVCacheSpec(num_layers=1, num_slots=4, num_blocks=7,
+                             block_size=2, max_len=8, num_heads=2,
+                             head_dim=4, num_groups=2).validate()
+
+
+# --------------------------------------------------------------------- #
+# Host allocator: refcounts, prefix cache, CoW, exhaustion
+# --------------------------------------------------------------------- #
+class TestBlockAllocator:
+    SPEC = PagedKVCacheSpec(num_layers=1, num_slots=4, num_blocks=8,
+                            block_size=4, max_len=16, num_heads=2,
+                            head_dim=4, num_groups=1, dtype=jnp.float32)
+
+    def test_share_then_refcount_return_on_release(self):
+        alloc = kv_cache.BlockAllocator(self.SPEC)
+        prompt = _prompt(9, seed=1)                 # 2 full blocks + 1
+        a = alloc.admit_prompt(0, 0, prompt, max_new=2)
+        assert len(a.table) == 3 and a.matched == 0
+        b = alloc.admit_prompt(1, 0, prompt, max_new=2)
+        assert b.table[:2] == a.table[:2], "full blocks shared"
+        assert b.table[2] != a.table[2], "partial block private"
+        assert b.matched == 8 and b.cow_src is None
+        assert alloc.blocks_in_use() == 4
+        alloc.release(1, b.table)
+        # Shared refs dropped; a's blocks still live.
+        assert alloc.blocks_in_use() == 3
+        alloc.release(0, a.table)
+        assert alloc.blocks_in_use() == 0
+        # Prefix blocks are LRU-retained (still matchable), private
+        # partial block went back to the free list.
+        assert alloc.available(0) == 8
+        assert len(alloc.match_prefix(0, prompt)[0]) == 2
+
+    def test_exact_match_forks_copy_on_write(self):
+        alloc = kv_cache.BlockAllocator(self.SPEC)
+        prompt = _prompt(8, seed=2)                 # exactly 2 blocks
+        a = alloc.admit_prompt(0, 0, prompt, max_new=2)
+        b = alloc.admit_prompt(1, 0, prompt, max_new=2)
+        assert b.cow_src == a.table[1] and b.cow_dst == b.table[1]
+        assert b.table[0] == a.table[0] and b.table[1] != a.table[1]
+        assert b.matched == 7, "last token always re-prefills"
+        assert alloc.cow_copies == 1
+
+    def test_exhaustion_rejects_without_touching_live_state(self):
+        alloc = kv_cache.BlockAllocator(self.SPEC)   # 8 blocks
+        a = alloc.admit_prompt(0, 0, _prompt(13, seed=3), max_new=2)
+        alloc.admit_prompt(1, 0, _prompt(13, seed=4), max_new=2)
+        assert alloc.available(0) == 0 and alloc.blocks_in_use() == 8
+        with pytest.raises(PoolExhausted):
+            alloc.admit_prompt(2, 0, _prompt(13, seed=5), max_new=2)
+        assert not alloc.can_admit(0, _prompt(13, seed=5), 2)
+        # The reject changed nothing for the live slots.
+        assert alloc.available(0) == 0 and alloc.blocks_in_use() == 8
+        # An evict returns capacity and the queued request admits.
+        alloc.release(0, a.table)
+        c = alloc.admit_prompt(2, 0, _prompt(13, seed=5), max_new=2)
+        assert len(c.table) == 4
+
+    def test_lru_reclaim_under_pressure(self):
+        alloc = kv_cache.BlockAllocator(self.SPEC)
+        p1 = _prompt(8, seed=5)
+        a = alloc.admit_prompt(0, 0, p1, max_new=0)
+        alloc.release(0, a.table)
+        assert len(alloc.match_prefix(0, p1)[0]) == 2   # retained
+        # A request needing all 8 blocks reclaims the retained ones.
+        b = alloc.admit_prompt(1, 0, _prompt(15, seed=6), max_new=1)
+        assert len(b.table) == 4
+        alloc.admit_prompt(2, 0, _prompt(15, seed=7), max_new=1)
+        assert alloc.match_prefix(0, p1)[0] == [], "reclaimed"
+        assert alloc.reclaimed > 0
+
+
+# --------------------------------------------------------------------- #
+# Paged vs slot-major logit parity (fp32) — the PR-7 diff
+# --------------------------------------------------------------------- #
+class TestPagedParity:
+    def test_block_table_decode_matches_slot_major(self, params32):
+        paged = _engine(params32, paged=True, block_size=16)
+        slot_major = _engine(params32, paged=False)
+        prompt = _prompt(11, seed=8)
+        tok_p, lg_p = paged.prefill(prompt, slot=0, return_logits=True)
+        tok_s, lg_s = slot_major.prefill(prompt, slot=0,
+                                         return_logits=True)
+        np.testing.assert_allclose(lg_p, lg_s, atol=1e-4)
+        assert tok_p == tok_s
+        paged.activate_slot(0, len(prompt), tok_p)
+        slot_major.activate_slot(0, len(prompt), tok_s)
+        seq = list(prompt) + [tok_p]
+        for _ in range(6):
+            sp, lp = paged.decode_once(return_logits=True)
+            ss, ls = slot_major.decode_once(return_logits=True)
+            np.testing.assert_allclose(lp[0], ls[0], atol=1e-4)
+            ref = np.asarray(gpt2_apply(
+                params32, jnp.asarray(np.asarray(seq, np.int32))[None],
+                CFG32))[0, -1]
+            np.testing.assert_allclose(lp[0], ref, atol=1e-4)
+            assert int(sp[0]) == int(ss[0])
+            seq.append(int(sp[0]))
+        paged.close()
+        slot_major.close()
+
+    def test_cow_fork_isolates_divergent_decode(self, params32):
+        """The copy-on-write fork: two identical prompts share all full
+        blocks; the forked slot's decode appends must not leak into the
+        original's attention."""
+        eng = _engine(params32, slots=16, block_size=8)
+        prompt = _prompt(16, seed=9)                # exactly 2 blocks
+        tok_a, lg_a = eng.prefill(prompt, slot=0, return_logits=True)
+        eng.activate_slot(0, len(prompt), tok_a)
+        tok_b, lg_b = eng.prefill(prompt, slot=1, return_logits=True)
+        eng.activate_slot(1, len(prompt), tok_b)
+        assert eng.allocator.cow_copies == 1
+        assert eng.block_tables[0][0] == eng.block_tables[1][0]
+        assert eng.block_tables[0][1] != eng.block_tables[1][1]
+        np.testing.assert_allclose(lg_a, lg_b, atol=1e-5)
+        # Force divergence: feed slot 1 a DIFFERENT pending token (the
+        # first divergent token goes through the forked private block).
+        eng.last_tokens[1] = (tok_b + 1) % CFG32.vocab_size
+        seq_a = list(prompt) + [tok_a]
+        seq_b = list(prompt) + [int(eng.last_tokens[1])]
+        for _ in range(5):
+            sampled, lg = eng.decode_once(return_logits=True)
+            for slot, seq in ((0, seq_a), (1, seq_b)):
+                ref = np.asarray(gpt2_apply(
+                    params32,
+                    jnp.asarray(np.asarray(seq, np.int32))[None],
+                    CFG32))[0, -1]
+                np.testing.assert_allclose(lg[slot], ref, atol=1e-4)
+                seq.append(int(sampled[slot]))
+        assert seq_a[len(prompt) + 1:] != seq_b[len(prompt) + 1:] or \
+            seq_a != seq_b
+        eng.close()
+
+    def test_prefill_many_matches_sequential(self, params32):
+        """Batched one-slot-per-group admission == one-at-a-time."""
+        batched = _engine(params32, slots=8, block_size=16)
+        seq = _engine(params32, slots=8, block_size=16)
+        prompts = [_prompt(7 + i, seed=20 + i) for i in range(4)]
+        # Slots 0..3 live in distinct groups (slots_per_group == 1).
+        results = batched.prefill_many(
+            [(i, p, 4) for i, p in enumerate(prompts)],
+            return_logits=True)
+        for i, p in enumerate(prompts):
+            tok, lg = seq.prefill(p, slot=i, return_logits=True)
+            assert results[i][0] == tok
+            np.testing.assert_allclose(results[i][1], lg, atol=1e-5)
+        batched.close()
+        seq.close()
+
+    def test_whole_prompt_prefill_paged(self, params32):
+        eng = _engine(params32, max_len=32, chunk=0, block_size=16)
+        prompt = _prompt(9, seed=10)
+        tok, logits = eng.prefill(prompt, slot=2, return_logits=True)
+        ref = np.asarray(gpt2_apply(
+            params32, jnp.asarray(prompt)[None], CFG32))[0, -1]
+        np.testing.assert_allclose(logits, ref, atol=1e-4)
+        eng.activate_slot(2, len(prompt), tok)
+        sampled, lg = eng.decode_once(return_logits=True)
+        ref2 = np.asarray(gpt2_apply(
+            params32, jnp.asarray(np.asarray(list(prompt) + [tok],
+                                             np.int32))[None],
+            CFG32))[0, -1]
+        np.testing.assert_allclose(lg[2], ref2, atol=1e-4)
+        eng.close()
+
+
+# --------------------------------------------------------------------- #
+# Pool exhaustion through the scheduler: reject, queue, recover
+# --------------------------------------------------------------------- #
+class TestAdmissionGate:
+    def test_exhaustion_queues_and_recovers(self, params):
+        """A pool sized for ~2 concurrent requests serves 4: the third
+        admission is REJECTED while two run (free-block accounting),
+        then admitted once a slot evicts and returns its blocks. Every
+        request completes; zero recompiles."""
+        eng = _engine(params, cfg=CFG, slots=16, max_len=64, chunk=8,
+                      block_size=8, num_blocks=16,
+                      telemetry={"enabled": True,
+                                 "output_path": "/tmp/_paged_gate",
+                                 "job_name": "gate",
+                                 "report_steps": 10 ** 6,
+                                 "fail_on_recompile": True})
+        # 16 blocks over 8 groups = 2/group; slots_per_group = 2. Each
+        # request needs ceil((12 + 4)/8) = 2 blocks -> one per group at
+        # a time; 16 slots but HBM for only 8 concurrent requests.
+        reqs = synthetic_requests(12, prompt_len=(10, 12),
+                                  max_new_tokens=4,
+                                  vocab_size=CFG.vocab_size, seed=11)
+        report = eng.serve(reqs)
+        assert report["completed"] == 12 and report["unfinished"] == 0
+        assert report["recompiles"] == 0
+        assert not eng.active.any()
+        assert eng.allocator.blocks_in_use() == 0
+        eng.close()
+
+    def test_never_admittable_raises_instead_of_hanging(self, params):
+        eng = _engine(params, cfg=CFG, slots=8, max_len=64, chunk=8,
+                      block_size=8, num_blocks=8)   # 1 block/group
+        reqs = synthetic_requests(1, prompt_len=(20, 20),
+                                  max_new_tokens=8,
+                                  vocab_size=CFG.vocab_size, seed=12)
+        with pytest.raises(RuntimeError, match="never be admitted"):
+            eng.serve(reqs)
+        eng.close()
+
+    def test_select_slot_prefers_prefix_affinity_group(self, params32):
+        eng = _engine(params32, slots=16, block_size=8)
+        prompt = _prompt(17, seed=13)
+        tok, _ = eng.prefill(prompt, slot=5, max_new_tokens=4,
+                             return_logits=False)
+        eng.activate_slot(5, len(prompt), tok)
+        # Slot 5 lives in group 2 (slots_per_group=2); a same-prefix
+        # admission must land there.
+        slot = eng.select_slot(prompt, max_new_tokens=4)
+        assert slot is not None and eng.group_of(slot) == \
+            eng.group_of(5)
+        assert eng.prefix_match_tokens(prompt) == 16
+        eng.close()
+
+
+# --------------------------------------------------------------------- #
+# Speculative decoding
+# --------------------------------------------------------------------- #
+class TestSpeculativeDecoding:
+    def test_drafter_proposes_continuation_of_repeats(self):
+        d = NGramDrafter(k=3, ngram=2)
+        d.begin(0, [1, 2, 3, 9, 1, 2])
+        assert d.propose(0).tolist() == [3, 9, 1]
+        d2 = NGramDrafter(k=2, ngram=3)
+        d2.begin(1, [5])
+        assert d2.propose(1).tolist() == [5, 5], "repeat-last fallback"
+        assert d.match_rate() == 1.0 and d2.match_rate() == 0.0
+
+    def test_greedy_streams_bit_identical(self, params):
+        """THE spec-decode acceptance gate: same checkpoint, same
+        stream, spec_k 0 vs 4 — token streams must be exactly equal,
+        and the spec run must do it in fewer iterations."""
+        def run(spec_k):
+            eng = _engine(params, cfg=CFG, spec_k=spec_k)
+            reqs = synthetic_requests(16, prompt_len=(5, 14),
+                                      max_new_tokens=12,
+                                      vocab_size=CFG.vocab_size, seed=2)
+            rep = eng.serve(reqs)
+            snap = eng.serving.snapshot()
+            eng.close()
+            return rep, snap
+
+        rep0, _ = run(0)
+        rep4, snap4 = run(4)
+        s0 = {r["rid"]: r["tokens"] for r in rep0["requests"]}
+        s4 = {r["rid"]: r["tokens"] for r in rep4["requests"]}
+        assert s0 == s4, "speculative greedy diverged from baseline"
+        assert rep4["iterations"] < rep0["iterations"]
+        assert rep0["recompiles"] == 0 and rep4["recompiles"] == 0
+        spec = snap4["spec"]
+        assert spec["proposed"] > 0
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+
+    def test_verify_near_slot_capacity_caps_cleanly(self, params):
+        """Speculation at the slot boundary: accepted tokens past
+        max_len are dropped, lengths never exceed capacity, and the
+        stream still matches baseline."""
+        def run(spec_k):
+            eng = _engine(params, cfg=CFG, max_len=32, spec_k=spec_k,
+                          block_size=16)
+            reqs = synthetic_requests(4, prompt_len=(24, 26),
+                                      max_new_tokens=16,
+                                      vocab_size=CFG.vocab_size,
+                                      seed=14)
+            rep = eng.serve(reqs)
+            assert (eng.lengths == 0).all()
+            eng.close()
+            return {r["rid"]: r["tokens"] for r in rep["requests"]}
+
+        assert run(0) == run(4)
+
+    def test_spec_requires_paged(self, params32):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError, match="paged"):
+            _engine(params32, paged=False, spec_k=4)
+
+    def test_temperature_falls_back_to_plain_decode(self, params):
+        eng = _engine(params, cfg=CFG, spec_k=4)
+        with pytest.raises(ValueError, match="greedy-only"):
+            eng.spec_decode_once(temperature=0.7)
+        reqs = synthetic_requests(4, prompt_len=(5, 8),
+                                  max_new_tokens=4,
+                                  vocab_size=CFG.vocab_size, seed=15)
+        rep = eng.serve(reqs, temperature=1.0)
+        assert rep["completed"] == 4
+        assert "spec" not in eng.serving.snapshot(), \
+            "sampling stream must not use the greedy acceptance rule"
+        eng.close()
+
+
+# --------------------------------------------------------------------- #
+# Multi-replica router
+# --------------------------------------------------------------------- #
+class TestReplicaRouter:
+    def test_two_replicas_balance_and_stay_labeled(self, params):
+        engines = [InferenceEngine(CFG, params, config={
+            "inference": {"max_slots": 8, "max_seq_len": 64,
+                          "prefill_chunk": 8, "spec_k": 4,
+                          "replica": f"r{i}"}}) for i in range(2)]
+        reqs = shared_prefix_requests(20, prefix_len=32,
+                                      tail_len=(4, 10),
+                                      max_new_tokens=8,
+                                      vocab_size=CFG.vocab_size, seed=3)
+        rep = ReplicaRouter(engines, temperature=0.0).serve(reqs)
+        assert rep["completed"] == 20 and rep["unfinished"] == 0
+        assert rep["recompiles"] == 0
+        assert sorted(r["replica"] for r in rep["replicas"]) == \
+            ["r0", "r1"]
+        assert sum(rep["router"]["routed"]) == 20
+        assert min(rep["router"]["routed"]) > 0, "load balanced"
+        # Every request names its replica; aggregate pools them.
+        assert {r["replica"] for r in rep["requests"]} == {0, 1}
+        assert rep["ttft_ms"]["n"] == 20
+        assert rep["prefix"]["hit_rate"] > 0, "shared prefixes hit"
+        for e in engines:
+            e.close()
+
+    def test_affinity_routes_to_prefix_holder(self, params32):
+        engines = [_engine(params32, slots=8, block_size=8)
+                   for _ in range(2)]
+        prompt = _prompt(24, seed=16)
+        tok, _ = engines[1].prefill(prompt, slot=0, return_logits=False)
+        engines[1].activate_slot(0, len(prompt), tok)
+        router = ReplicaRouter(engines, affinity_weight=1.0)
+        from deepspeed_tpu.inference import Request
+        from collections import deque
+        req = Request(rid=0, prompt=prompt, max_new_tokens=4)
+        assert router.route(req, [deque(), deque()]) == 1
+        for e in engines:
+            e.close()
+
+    def test_router_never_admittable_raises_instead_of_hanging(
+            self, params32):
+        engines = [_engine(params32, slots=8, max_len=64, block_size=8,
+                           num_blocks=8) for _ in range(2)]
+        from deepspeed_tpu.inference import Request
+        reqs = [Request(rid=0, prompt=_prompt(20, seed=30),
+                        max_new_tokens=8)]
+        with pytest.raises(RuntimeError, match="never be admitted"):
+            ReplicaRouter(engines).serve(reqs)
+        for e in engines:
+            e.close()
+
+    def test_aggregator_merged_pools_raw_samples(self):
+        a = ServingAggregator(8, label="r0")
+        b = ServingAggregator(8, label="r1")
+        for ms in (10, 20, 30):
+            a.note_request(ms / 1e3, None, 4)
+        for ms in (100, 200, 300):
+            b.note_request(ms / 1e3, None, 4)
+        a.note_iteration(8, 0.01, cache_bytes=1000, context_tokens=10)
+        b.note_iteration(4, 0.01, cache_bytes=3000, context_tokens=10)
+        m = ServingAggregator.merged([a, b])
+        snap = m.snapshot(wall_s=1.0)
+        assert snap["replica"] == "aggregate"
+        assert snap["completed"] == 6
+        assert snap["ttft_ms"]["n"] == 6
+        # Pooled median sits between the two replicas' medians.
+        assert 20 <= snap["ttft_ms"]["p50"] <= 200
+        assert snap["occupancy_mean"] == pytest.approx(0.75)
+        assert snap["hbm_bytes_per_token"]["n"] == 2
+        assert a.snapshot()["replica"] == "r0"
+
+
+# --------------------------------------------------------------------- #
+# Workloads and config knobs
+# --------------------------------------------------------------------- #
+class TestWorkloadsAndConfig:
+    def test_shared_prefix_requests_share_exactly_the_prefix(self):
+        reqs = shared_prefix_requests(6, prefix_len=16, tail_len=(2, 5),
+                                      seed=4)
+        p0 = reqs[0].prompt[:16]
+        for r in reqs:
+            assert (r.prompt[:16] == p0).all()
+            assert 18 <= len(r.prompt) <= 21
+        again = shared_prefix_requests(6, prefix_len=16,
+                                       tail_len=(2, 5), seed=4)
+        assert all((a.prompt == b.prompt).all()
+                   for a, b in zip(reqs, again))
+
+    def test_new_inference_knobs_validate(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfigError,
+                                                  InferenceConfig)
+        inf = InferenceConfig(None)
+        assert inf.block_size == 16 and inf.num_blocks == 0
+        assert inf.spec_k == 0 and inf.kv_cache_dtype == "model"
+        for bad in ({"block_size": -1}, {"spec_k": -2},
+                    {"spec_k": 2, "block_size": 0},
+                    {"kv_cache_dtype": "fp8"}, {"replica": 3},
+                    {"num_blocks": -4}, {"spec_ngram": 0}):
+            with pytest.raises(DeepSpeedConfigError):
+                InferenceConfig({"inference": bad})
+
+    def test_engine_geometry_validation(self, params32):
+        with pytest.raises(ValueError, match="block_size"):
+            _engine(params32, max_len=40, block_size=16)
+        with pytest.raises(ValueError, match="divisible"):
+            _engine(params32, block_size=16, num_blocks=12)
+
+    def test_bf16_kv_pool_serves(self, params32):
+        eng = InferenceEngine(CFG32, params32, config={
+            "inference": {"max_slots": 8, "max_seq_len": 32,
+                          "prefill_chunk": 8,
+                          "kv_cache_dtype": "bf16"}})
+        assert eng.cache["k"].dtype == jnp.bfloat16
+        prompt = _prompt(9, seed=17)
+        tok, logits = eng.prefill(prompt, slot=0, return_logits=True)
+        ref = np.asarray(gpt2_apply(
+            params32, jnp.asarray(prompt)[None], CFG32))[0, -1]
+        assert np.isfinite(logits).all()
+        assert np.corrcoef(logits, ref)[0, 1] > 0.999
+        eng.close()
+
+
+# --------------------------------------------------------------------- #
+# The paged serving stream under the sentinel + lint (tier-1 gate)
+# --------------------------------------------------------------------- #
+class TestPagedServingStream:
+    def test_shared_prefix_stream_zero_recompiles_and_lint_clean(
+            self, tmp_path, params):
+        eng = InferenceEngine(CFG, params, config={
+            "inference": {"max_slots": 8, "max_seq_len": 64,
+                          "prefill_chunk": 8, "block_size": 8,
+                          "spec_k": 3},
+            "telemetry": {"enabled": True, "output_path": str(tmp_path),
+                          "job_name": "paged_serve",
+                          "report_steps": 10 ** 6,
+                          "fail_on_recompile": True}})
+        # Deterministic copy-on-write exercise first: admit a 4-full-
+        # block prompt, evict (blocks LRU-retained), re-admit the SAME
+        # prompt — the exact-chain match forks its last block, so the
+        # copy_block path compiles and registers with the sentinel.
+        p32 = _prompt(32, seed=50, vocab=CFG.vocab_size)
+        tok, _ = eng.prefill(p32, slot=0)
+        eng.activate_slot(0, 32, tok)
+        eng.release_slot(0)
+        tok, _ = eng.prefill(p32, slot=0)
+        eng.activate_slot(0, 32, tok)
+        eng.release_slot(0)
+        assert eng.allocator.cow_copies == 1
+        reqs = shared_prefix_requests(16, prefix_len=24,
+                                      tail_len=(3, 9),
+                                      max_new_tokens=6,
+                                      vocab_size=CFG.vocab_size, seed=5)
+        report = eng.serve(reqs)
+        assert report["completed"] == 16 and report["unfinished"] == 0
+        assert report["recompiles"] == 0
+        assert eng.telemetry.recompile_count == 0
+        snap = eng.serving.snapshot()
+        assert snap["prefix"]["hit_rate"] > 0
+        assert snap["hbm_bytes_per_token"]["n"] > 0
+        assert snap["spec"]["proposed"] > 0
+        # Every compiled path this serve used registered (a spec-k
+        # engine decodes THROUGH the verify step, so plain decode_step
+        # never compiles); host_sync + materialization CLEAN — no
+        # full-pool gather, no in-step host transfer, even through the
+        # verify and CoW-copy paths.
+        lint = eng.lint_audit(passes=("host_sync", "materialization"))
+        assert {p.name for p in lint.paths} == \
+            {"prefill_step", "verify_step", "copy_block"}
+        assert not lint.unwaived and \
+            not any(p.errors for p in lint.paths)
+        eng.close()

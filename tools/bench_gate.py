@@ -30,7 +30,13 @@ step changes, not jitter); the fused-kernel ablation speedup (the
 ``ablate_fused_ln.py`` records) regresses on a relative drop beyond
 ``--kernel-drop`` (default 10%); the ZeRO-3 prefetch overlap fraction
 (``zero3.overlap_fraction`` from ablate_zero3_prefetch.py's
-ZERO3_BENCH.json) regresses on the same relative threshold. A TELEMETRY.json carrying a ``health``
+ZERO3_BENCH.json) regresses on the same relative threshold. Paged-cache
+serving rounds additionally gate ``serving.hbm_bytes_per_token`` (p50;
+regression = a relative RISE beyond ``--hbm-rise``, default 15%) and
+the spec-decode ``serving.spec.acceptance_rate`` (new side must clear
+``--accept-floor``, default 0.05, and must not drop more than
+``--serve-drop`` relative vs the old side when both carry it) —
+pre-paging/pre-spec rounds skip these, never fail. A TELEMETRY.json carrying a ``health``
 section is additionally validated on the NEW side alone: UNSKIPPED
 non-finite anomalies (overflow-skipped steps are routine fp16
 loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
@@ -94,6 +100,8 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         goodput = float(v) if v is not None else None
     # Serving shape: SERVE_BENCH.json's "serving" record, or a
     # serving-mode TELEMETRY.json's "serving" section (same keys).
+    hbm_per_token: Optional[float] = None
+    accept_rate: Optional[float] = None
     srv = doc.get("serving")
     if isinstance(srv, dict) and (srv.get("available", True)):
         v = srv.get("tokens_per_s")
@@ -101,6 +109,17 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         ttft = srv.get("ttft_ms")
         if isinstance(ttft, dict) and ttft.get("p95") is not None:
             ttft_p95 = float(ttft["p95"])
+        # Paged-cache rounds: HBM held per cached token (regression =
+        # RISE) and the spec-decode acceptance rate (regression = drop
+        # below the floor or vs the previous round). Pre-paging rounds
+        # carry neither -> skipped, never failed.
+        hbm = srv.get("hbm_bytes_per_token")
+        if isinstance(hbm, dict) and hbm.get("p50") is not None:
+            hbm_per_token = float(hbm["p50"])
+        spec = srv.get("spec")
+        if isinstance(spec, dict) and \
+                spec.get("acceptance_rate") is not None:
+            accept_rate = float(spec["acceptance_rate"])
     # Health-layer TELEMETRY.json shape: validated (new side only), not
     # diffed. Pre-health rounds carry no section -> None -> skipped.
     health: Optional[Dict[str, Any]] = None
@@ -119,7 +138,8 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         }
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
-            "zero3_overlap": zero3_overlap, "health": health}
+            "zero3_overlap": zero3_overlap, "health": health,
+            "hbm_per_token": hbm_per_token, "accept_rate": accept_rate}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -142,7 +162,8 @@ def latest_rounds(directory: str) -> Optional[Tuple[str, str]]:
 
 def gate(old_path: str, new_path: str, mfu_drop: float,
          goodput_drop: float, serve_drop: float = 0.10,
-         ttft_rise: float = 0.25, kernel_drop: float = 0.10) -> int:
+         ttft_rise: float = 0.25, kernel_drop: float = 0.10,
+         hbm_rise: float = 0.15, accept_floor: float = 0.05) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -228,6 +249,48 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"kernel fused speedup: skipped (no kernels record in "
               f"{', '.join(missing)})")
 
+    if old["hbm_per_token"] is not None and \
+            new["hbm_per_token"] is not None:
+        compared += 1
+        ceil = old["hbm_per_token"] * (1.0 + hbm_rise)
+        verdict = "OK" if new["hbm_per_token"] <= ceil else "REGRESSION"
+        print(f"serving hbm bytes/token: {name_old}="
+              f"{old['hbm_per_token']:.4g}B -> "
+              f"{name_new}={new['hbm_per_token']:.4g}B "
+              f"(ceiling {ceil:.4g}B, +{hbm_rise:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-paging rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["hbm_per_token"] is None]
+        print(f"serving hbm bytes/token: skipped (no paged-cache "
+              f"record in {', '.join(missing)})")
+
+    if new["accept_rate"] is not None:
+        compared += 1
+        bad = []
+        if new["accept_rate"] < accept_floor:
+            bad.append(f"below floor {accept_floor:.2f}")
+        if old["accept_rate"] is not None:
+            drop_floor = old["accept_rate"] * (1.0 - serve_drop)
+            if new["accept_rate"] < drop_floor:
+                bad.append(f"dropped >{serve_drop:.0%} rel vs "
+                           f"{old['accept_rate']:.4g}")
+        verdict = "OK" if not bad else "REGRESSION"
+        print(f"spec-decode acceptance: {name_new}="
+              f"{new['accept_rate']:.4g}"
+              + (f" (prev {old['accept_rate']:.4g})"
+                 if old["accept_rate"] is not None else "")
+              + f": {'; '.join(bad) if bad else 'above floor'}"
+              f": {verdict}")
+        if bad:
+            rc = 1
+    else:
+        # Pre-spec-decode rounds skip, never fail.
+        print(f"spec-decode acceptance: skipped (no spec record in "
+              f"{name_new})")
+
     if old["zero3_overlap"] is not None and \
             new["zero3_overlap"] is not None:
         compared += 1
@@ -297,6 +360,12 @@ def main(argv=None) -> int:
     ap.add_argument("--kernel-drop", type=float, default=0.10,
                     help="max tolerated RELATIVE drop of the fused-"
                          "kernel speedup (default 0.10)")
+    ap.add_argument("--hbm-rise", type=float, default=0.15,
+                    help="max tolerated RELATIVE rise of serving HBM "
+                         "bytes per cached token (default 0.15)")
+    ap.add_argument("--accept-floor", type=float, default=0.05,
+                    help="spec-decode acceptance-rate floor on the new "
+                         "side (default 0.05)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -312,7 +381,8 @@ def main(argv=None) -> int:
         return 2
     try:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
-                    args.serve_drop, args.ttft_rise, args.kernel_drop)
+                    args.serve_drop, args.ttft_rise, args.kernel_drop,
+                    args.hbm_rise, args.accept_floor)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

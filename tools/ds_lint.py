@@ -20,10 +20,13 @@ Flagships (the engine modes whose compiled programs differ):
 - **onebit**  — 1-bit Adam compression step (stage 0 shard_map psums)
 - **offload** — ZeRO-Offload bucketed grad pass (host Adam)
 - **pipeline_1f1b** — compiled pp=2 interleaved pipeline ticks
-- **serving** — the inference tier's decode + chunked-prefill paths
-  (gpt2-tiny, continuous batching); the serving contract is host_sync
-  and materialization CLEAN: no full-cache gather under the slot-over-dp
-  sharding, no in-step host transfer
+- **serving** — the inference tier's paged compiled paths (gpt2-tiny,
+  continuous batching over the block pool): group-batched chunked
+  prefill, plain decode, the speculative verify step, and the
+  copy-on-write block copy; the serving contract is host_sync and
+  materialization CLEAN: no full-pool gather through the block-table
+  one-hot contractions under the blocks-over-dp sharding, no in-step
+  host transfer
 
 Known-and-roadmapped findings live in ``tools/lint_waivers.json`` —
 every waiver must match a live finding (stale waivers fail ``--check``),
@@ -206,20 +209,41 @@ def build_pipeline_1f1b():
 
 
 def build_serving():
-    from deepspeed_tpu.inference import InferenceEngine, synthetic_requests
+    from deepspeed_tpu.inference import (InferenceEngine,
+                                         shared_prefix_requests,
+                                         synthetic_requests)
     from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init
 
     cfg = GPT2_CONFIGS["gpt2-tiny"]
     engine = InferenceEngine(
         cfg, gpt2_init(jax.random.PRNGKey(0), cfg),
         config={"inference": {"max_slots": 8, "max_seq_len": 64,
-                              "prefill_chunk": 8},
+                              "prefill_chunk": 8, "block_size": 8,
+                              "spec_k": 3},
                 "telemetry": _tel("serving")})
-    # A short continuous-batching serve registers both compiled paths
-    # (decode_step + prefill_step) with the sentinel.
-    engine.serve(synthetic_requests(4, prompt_len=(6, 12),
-                                    max_new_tokens=4,
-                                    vocab_size=cfg.vocab_size))
+    # Register every paged compiled path with the sentinel: an exact
+    # re-admission forks copy-on-write (copy_block), the shared-prefix
+    # serve runs batched chunk prefills + speculative verify steps, and
+    # one plain decode covers the non-spec decode path. The serving
+    # contract the passes then gate: materialization must prove no
+    # full-pool gather through the block-table one-hot contractions,
+    # and host_sync must show zero in-step transfers (the one
+    # token-fetch per iteration happens outside the programs).
+    rng = np.random.default_rng(0)
+    p32 = rng.integers(0, cfg.vocab_size, size=32).astype(np.int32)
+    for _ in range(2):                      # second pass hits CoW
+        tok, _ = engine.prefill(p32, slot=0)
+        engine.activate_slot(0, 32, tok)
+        engine.release_slot(0)
+    assert engine.allocator.cow_copies == 1
+    engine.serve(shared_prefix_requests(6, prefix_len=16,
+                                        tail_len=(3, 8),
+                                        max_new_tokens=4,
+                                        vocab_size=cfg.vocab_size))
+    tok, _ = engine.prefill(p32[:8], slot=0)
+    engine.activate_slot(0, 8, tok)
+    engine.decode_once()                    # the non-spec decode path
+    engine.release_slot(0)
     return engine
 
 

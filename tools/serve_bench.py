@@ -1,28 +1,36 @@
 #!/usr/bin/env python
 """Serving bench: drive a synthetic open-loop arrival stream through the
-InferenceEngine and record SERVE_BENCH.json.
+serving tier and record SERVE_BENCH.json.
 
-The serving acceptance artifact: batch occupancy, TTFT/TPOT p50/p95,
-generated tokens/s, decode-step wall percentiles, and the recompile
-count (which must be ZERO post-warmup — the bench runs with
-``fail_on_recompile`` armed, so a retrace kills the run rather than
-silently polluting the numbers). The engine's telemetry JSONL is
-summarized through ``tools/telemetry_report.py`` and its ``serving``
-section is embedded verbatim, proving the report pipeline and the bench
-agree on the same stream.
+The serving acceptance artifact, PR-12 shape: the default run drives the
+SHARED-PREFIX workload (one common system prompt + varying tails — the
+traffic paged prefix sharing is built for) through N paged+speculative
+``InferenceEngine`` replicas behind the prefix-affinity
+``ReplicaRouter``, and ALSO through a single slot-major PR-7-layout
+replica on the exact same request stream — so the paging/spec/replica
+win is a measured delta, not a claim. Recorded per side: batch
+occupancy, TTFT/TPOT p50/p95, generated tokens/s, decode-step wall
+percentiles, HBM-bytes-per-cached-token, prefix hit rate, spec-decode
+acceptance rate, per-replica aggregator snapshots (labeled — never one
+interleaved percentile stream) plus the pooled aggregate, and the
+recompile count (ZERO post-warmup — ``fail_on_recompile`` is armed, so
+a retrace kills the run rather than silently polluting the numbers).
 
 Honest methodology note (recorded in the artifact): on the virtual
-8-device CPU mesh the ABSOLUTE numbers (tokens/s, TTFT) measure XLA's
-CPU backend, not a TPU; what transfers is the structure — occupancy
-under continuous batching, the zero-recompile property, and the
-relative cost split between prefill and decode. ``tools/bench_gate.py``
-diffs serving rounds on these figures.
+8-device CPU mesh the ABSOLUTE numbers measure XLA's CPU backend, not a
+TPU, and emulated replicas interleave their steps on ONE mesh — their
+tokens/s and TTFT are a lower bound on disjoint-mesh replicas. What
+transfers is the structure — occupancy, zero recompiles, the
+prefill/decode split, HBM-per-token, acceptance and hit rates.
+``tools/bench_gate.py`` diffs serving rounds on these figures.
 
 Usage:
     python tools/serve_bench.py [--model gpt2-tiny] [--slots 8]
         [--requests 24] [--max-new 16] [--chunk 8] [--max-len 128]
+        [--block-size 16] [--num-blocks 0] [--spec-k 4] [--replicas 2]
+        [--workload shared-prefix|random] [--prefix-len 32]
         [--rate 0.0] [--quantize none] [--temperature 0.0]
-        [--out SERVE_BENCH.json]
+        [--no-baseline] [--out SERVE_BENCH.json]
 """
 import argparse
 import json
@@ -46,6 +54,64 @@ jax.config.update("jax_platforms", "cpu")
 import numpy as np             # noqa: E402
 
 
+def _requests(args, vocab_size):
+    """Regenerated per run (serve mutates request state) — same seed,
+    same stream on both sides of the comparison."""
+    from deepspeed_tpu.inference import (shared_prefix_requests,
+                                         synthetic_requests)
+    if args.workload == "shared-prefix":
+        return shared_prefix_requests(
+            args.requests, prefix_len=args.prefix_len,
+            tail_len=tuple(args.tail_len), max_new_tokens=args.max_new,
+            rate_rps=args.rate, vocab_size=vocab_size, seed=args.seed)
+    return synthetic_requests(
+        args.requests, prompt_len=tuple(args.prompt_len),
+        max_new_tokens=args.max_new, rate_rps=args.rate,
+        vocab_size=vocab_size, seed=args.seed)
+
+
+def _serve(args, cfg, params, *, replicas, block_size, spec_k, label):
+    """Build `replicas` engines and run the stream; returns (report,
+    telemetry dir of replica 0)."""
+    from deepspeed_tpu.inference import InferenceEngine, ReplicaRouter
+
+    tel_dir = tempfile.mkdtemp(prefix=f"serve_bench_{label}_")
+    engines = []
+    for i in range(replicas):
+        engines.append(InferenceEngine(cfg, params, config={
+            "inference": {"max_slots": args.slots,
+                          "max_seq_len": args.max_len,
+                          "prefill_chunk": args.chunk,
+                          "block_size": block_size,
+                          "num_blocks": args.num_blocks,
+                          "spec_k": spec_k,
+                          "quantize": args.quantize,
+                          "replica": f"r{i}"},
+            "telemetry": {"enabled": True, "output_path": tel_dir,
+                          "job_name": f"serve_bench_r{i}",
+                          "report_steps": 16,
+                          "fail_on_recompile": True}}))
+    if args.warmup:
+        # Warm every compiled path before the measured stream so TTFT
+        # measures serving, not XLA compiles — applied identically to
+        # both sides of the comparison. Short random prompts (< one
+        # block) leave the prefix cache untouched.
+        from deepspeed_tpu.inference import synthetic_requests
+        hi = max(4, min(10, args.chunk + 2)) if args.chunk else 10
+        warm = synthetic_requests(
+            max(2, 2 * replicas), prompt_len=(4, hi),
+            max_new_tokens=args.warmup, vocab_size=cfg.vocab_size,
+            seed=args.seed + 991)
+        ReplicaRouter(engines, temperature=args.temperature).serve(warm)
+        for e in engines:
+            e.reset_serving_stats()
+    router = ReplicaRouter(engines, temperature=args.temperature)
+    report = router.serve(_requests(args, cfg.vocab_size))
+    for e in engines:
+        e.close()
+    return report, tel_dir
+
+
 def main():
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--model", default="gpt2-tiny")
@@ -54,7 +120,18 @@ def main():
     ap.add_argument("--max-new", type=int, default=16)
     ap.add_argument("--chunk", type=int, default=8)
     ap.add_argument("--max-len", type=int, default=128)
-    ap.add_argument("--prompt-len", type=int, nargs=2, default=(8, 24))
+    ap.add_argument("--block-size", type=int, default=16)
+    ap.add_argument("--num-blocks", type=int, default=0,
+                    help="pool size; 0 = full provisioning")
+    ap.add_argument("--spec-k", type=int, default=4)
+    ap.add_argument("--replicas", type=int, default=2)
+    ap.add_argument("--workload", default="shared-prefix",
+                    choices=("shared-prefix", "random"))
+    ap.add_argument("--prefix-len", type=int, default=32,
+                    help="shared system-prompt length")
+    ap.add_argument("--tail-len", type=int, nargs=2, default=(4, 12))
+    ap.add_argument("--prompt-len", type=int, nargs=2, default=(8, 24),
+                    help="random-workload prompt length range")
     ap.add_argument("--rate", type=float, default=0.0,
                     help="open-loop arrival rate (req/s); 0 = saturation "
                          "(all arrive at t=0)")
@@ -62,60 +139,95 @@ def main():
                     choices=("none", "bf16", "int8"))
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--warmup", type=int, default=4,
+                    help="warmup tokens per throwaway request before "
+                         "the measured stream (0 = cold, PR-7 style)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="skip the slot-major single-replica baseline")
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
     args = ap.parse_args()
 
-    from deepspeed_tpu.inference import InferenceEngine, synthetic_requests
     from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init
 
     cfg = GPT2_CONFIGS[args.model]
     params = gpt2_init(jax.random.PRNGKey(args.seed), cfg)
-    tel_dir = tempfile.mkdtemp(prefix="serve_bench_")
-    engine = InferenceEngine(cfg, params, config={
-        "inference": {"max_slots": args.slots, "max_seq_len": args.max_len,
-                      "prefill_chunk": args.chunk,
-                      "quantize": args.quantize},
-        "telemetry": {"enabled": True, "output_path": tel_dir,
-                      "job_name": "serve_bench", "report_steps": 16,
-                      "fail_on_recompile": True}})
-    requests = synthetic_requests(
-        args.requests, prompt_len=tuple(args.prompt_len),
-        max_new_tokens=args.max_new, rate_rps=args.rate,
-        vocab_size=cfg.vocab_size, seed=args.seed)
-    print(f"[serve_bench] {args.model}: {args.requests} requests, "
-          f"{args.slots} slots, max_new={args.max_new}, "
-          f"chunk={args.chunk}, quantize={args.quantize} ...", flush=True)
-    report = engine.serve(requests, temperature=args.temperature)
-    engine.close()
+
+    print(f"[serve_bench] {args.model}: {args.requests} requests "
+          f"({args.workload}), {args.replicas} replica(s) x {args.slots} "
+          f"slots, paged bs={args.block_size}, spec_k={args.spec_k}, "
+          f"max_new={args.max_new}, chunk={args.chunk}, "
+          f"quantize={args.quantize} ...", flush=True)
+    report, tel_dir = _serve(args, cfg, params, replicas=args.replicas,
+                             block_size=args.block_size,
+                             spec_k=args.spec_k, label="paged")
+
+    baseline = None
+    if not args.no_baseline:
+        print("[serve_bench] slot-major single-replica baseline on the "
+              "same stream ...", flush=True)
+        baseline, _ = _serve(args, cfg, params, replicas=1, block_size=0,
+                             spec_k=0, label="slotmajor")
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from telemetry_report import summarize
-    telemetry = summarize(os.path.join(tel_dir, "serve_bench.jsonl"))
+    telemetry = summarize(os.path.join(tel_dir, "serve_bench_r0.jsonl"))
 
+    serving = {k: v for k, v in report.items()
+               if k not in ("requests", "replicas", "router")}
     record = {
         "generated_by": "tools/serve_bench.py",
         "mesh": {"devices": jax.device_count(),
                  "backend": jax.devices()[0].platform,
-                 "jax": jax.__version__,
-                 "dp": engine.dp, "mp": engine.mp},
+                 "jax": jax.__version__},
         "model": args.model,
         "config": {"max_slots": args.slots, "max_seq_len": args.max_len,
                    "prefill_chunk": args.chunk,
+                   "block_size": args.block_size,
+                   "num_blocks": args.num_blocks,
+                   "spec_k": args.spec_k, "replicas": args.replicas,
+                   "workload": args.workload,
+                   "prefix_len": args.prefix_len,
+                   "tail_len": list(args.tail_len),
                    "quantize": args.quantize, "requests": args.requests,
                    "max_new_tokens": args.max_new,
                    "prompt_len": list(args.prompt_len),
                    "arrival_rate_rps": args.rate,
                    "temperature": args.temperature},
-        "serving": {k: v for k, v in report.items() if k != "requests"},
+        "serving": serving,
+        "replicas": report.get("replicas"),
+        "router": report.get("router"),
         "telemetry_report_serving": telemetry.get("serving"),
         "honest_note": (
             "virtual 8-device CPU mesh: absolute tokens/s and latency "
-            "measure XLA's CPU backend, not a TPU. The transferable "
-            "claims are structural — batch occupancy under continuous "
-            "batching, zero post-warmup recompiles (fail_on_recompile "
-            "was armed for this run), and the prefill/decode cost "
-            "split."),
+            "measure XLA's CPU backend, not a TPU, and emulated "
+            "replicas interleave on ONE mesh (a lower bound on "
+            "disjoint-mesh replicas). The transferable claims are "
+            "structural — occupancy under continuous batching, zero "
+            "post-warmup recompiles (fail_on_recompile was armed), the "
+            "prefill/decode cost split, HBM-bytes-per-token under "
+            "paging, prefix hit rate, and the spec-decode acceptance "
+            "rate."),
     }
+    if baseline is not None:
+        record["baseline_slot_major"] = {
+            k: v for k, v in baseline.items()
+            if k not in ("requests", "replicas", "router")}
+        b, s = record["baseline_slot_major"], serving
+
+        def _ratio(new, old):
+            return round(new / old, 4) if old else None
+
+        record["vs_slot_major"] = {
+            "ttft_p95_x": _ratio(s["ttft_ms"]["p95"],
+                                 b["ttft_ms"]["p95"]),
+            "tpot_p50_x": _ratio(s["tpot_ms"]["p50"],
+                                 b["tpot_ms"]["p50"]),
+            "tokens_per_s_x": _ratio(s["tokens_per_s"],
+                                     b["tokens_per_s"]),
+            "hbm_bytes_per_token_x": _ratio(
+                s.get("hbm_bytes_per_token", {}).get("p50", 0),
+                b.get("hbm_bytes_per_token", {}).get("p50", 0)),
+        }
     with open(args.out, "w") as f:
         json.dump(record, f, indent=1)
     s = record["serving"]
@@ -123,7 +235,14 @@ def main():
           f"{s['occupancy_mean']}, tokens/s={s['tokens_per_s']}, "
           f"ttft p50/p95={s['ttft_ms']['p50']}/{s['ttft_ms']['p95']} ms, "
           f"tpot p50/p95={s['tpot_ms']['p50']}/{s['tpot_ms']['p95']} ms, "
+          f"hbm/token p50="
+          f"{s.get('hbm_bytes_per_token', {}).get('p50', 'n/a')}B, "
+          f"prefix hit={s.get('prefix', {}).get('hit_rate', 'n/a')}, "
+          f"accept={s.get('spec', {}).get('acceptance_rate', 'n/a')}, "
           f"recompiles={s['recompiles']}, completed={s['completed']}")
+    if record.get("vs_slot_major"):
+        print(f"[serve_bench] vs slot-major baseline: "
+              f"{record['vs_slot_major']}")
     if s["recompiles"] or s["unfinished"]:
         print("[serve_bench] FAILED acceptance (recompiles or unfinished "
               "requests)")

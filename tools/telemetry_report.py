@@ -369,6 +369,38 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "prefill_tokens": serve_snap.get("prefill_tokens"),
             "decode_tokens": serve_snap.get("decode_tokens"),
         })
+        # Paged-cache / spec-decode sections of the aggregator snapshot
+        # pass through when present (pre-paging streams carry none).
+        for sec in ("hbm_bytes_per_token", "prefix", "spec", "replica"):
+            if serve_snap.get(sec) is not None:
+                serving[sec] = serve_snap[sec]
+        # Multi-replica streams: request_complete events carry replica
+        # labels — split the per-request percentiles per replica so two
+        # replicas' latency distributions never interleave into one
+        # misleading stream (the pooled figures above remain the honest
+        # aggregate).
+        labels = sorted({str(e["replica"]) for e in completions
+                         if e.get("replica") is not None})
+        if len(labels) > 1 or (labels and serving.get("replica")
+                               not in (None, labels[0])):
+            per_rep: Dict[str, Any] = {}
+            for lab in labels:
+                evs = [e for e in completions
+                       if str(e.get("replica")) == lab]
+                tt = sorted(float(e["ttft_ms"]) for e in evs
+                            if "ttft_ms" in e)
+                tp = sorted(float(e["tpot_ms"]) for e in evs
+                            if "tpot_ms" in e)
+                per_rep[lab] = {
+                    "completed": len(evs),
+                    "ttft_ms": {"p50": round(_percentile(tt, 50), 3),
+                                "p95": round(_percentile(tt, 95), 3),
+                                "n": len(tt)},
+                    "tpot_ms": {"p50": round(_percentile(tp, 50), 3),
+                                "p95": round(_percentile(tp, 95), 3),
+                                "n": len(tp)},
+                }
+            serving["replicas"] = per_rep
 
     # Truncation: a marker-capable segment without the terminal `final`
     # record died mid-run — its partial-window stats must not read as a
