@@ -343,14 +343,39 @@ def host_sync_pass(ctx: LintContext) -> List[LintFinding]:
 # ------------------------------------------------------------------ #
 def collective_placement_pass(ctx: LintContext) -> List[LintFinding]:
     meta = ctx.meta
+    out: List[LintFinding] = []
+    # MoE expert placement: an expert-sharded gradient may all-reduce
+    # over `data` (within its expert group) ONLY — replica groups wider
+    # than the data axis span the `expert` axis, i.e. the lowering
+    # treated experts as replicas and ships every group every other
+    # group's expert grads (the seeded-violation case; engine meta
+    # carries the legal per-device payload sizes + the max group width).
+    expert_bytes = {int(b) for b in (meta.get("expert_leaf_bytes") or ())}
+    if expert_bytes and ctx.audit is not None:
+        max_group = int(meta.get("expert_group_size") or 1)
+        for o in ctx.audit.of_kind("all-reduce"):
+            if o.payload_bytes in expert_bytes and o.group_size > max_group:
+                out.append(LintFinding(
+                    lint="collective_placement", path=ctx.name,
+                    key=f"expert-grad-allreduce:{','.join(o.out_shapes)}",
+                    summary=("expert-sharded gradient all-reduced ACROSS "
+                             f"the expert axis: {o.out_shapes} in groups "
+                             f"of {o.group_size} (data axis is "
+                             f"{max_group}) — experts are not replicas; "
+                             "their grads sync over data within the "
+                             "expert group only"),
+                    bytes=o.payload_bytes, wire_bytes=o.wire_bytes,
+                    priced=True, in_loop=o.in_loop,
+                    details={"op_name": o.op_name,
+                             "group_size": o.group_size,
+                             "expert_group_size": max_group}))
     if not meta.get("grad_sync_path"):
-        return []
+        return out
     mode = str(meta.get("grad_sync_mode", "none"))
     gas = int(meta.get("gas", 1))
     scatterable = {int(b) for b in (meta.get("scatterable_leaf_bytes") or ())}
     if not scatterable or ctx.audit is None:
-        return []
-    out: List[LintFinding] = []
+        return out
     expects_rs = mode in ("explicit", "declarative")
     grad_ars = [o for o in ctx.audit.of_kind("all-reduce")
                 if o.payload_bytes in scatterable]

@@ -518,6 +518,39 @@ PREFER_LARGER_BATCH = "prefer_larger_batch"
 PREFER_LARGER_BATCH_DEFAULT = True
 
 #############################################
+# MoE expert parallelism (moe/ subsystem)
+#############################################
+# The "moe" block configures the engine side of expert parallelism:
+# the `expert` mesh axis size (factors out of data — reuses the dp
+# devices), the metrics schema (per-expert token counts / drop fraction
+# / aux loss ride the telemetry drain), and the all-to-all wire model.
+# The MODEL side is TransformerConfig.moe (deepspeed_tpu.moe.MoEConfig
+# — build it with MoEConfig.from_ds_config so the two cannot drift).
+MOE = "moe"
+# 0 = MoE disabled (the block is inert).
+MOE_NUM_EXPERTS = "num_experts"
+MOE_NUM_EXPERTS_DEFAULT = 0
+# Router top-k (1 or 2 — Switch vs GShard gating).
+MOE_TOP_K = "top_k"
+MOE_TOP_K_DEFAULT = 2
+# Per-expert slot count C = ceil(capacity_factor * k * T / E) per
+# device; tokens beyond capacity drop to the residual path. One
+# compiled shape regardless of routing.
+MOE_CAPACITY_FACTOR = "capacity_factor"
+MOE_CAPACITY_FACTOR_DEFAULT = 1.25
+# Load-balance aux loss weight (Switch: E * sum(f_e * P_e)).
+MOE_AUX_LOSS_WEIGHT = "aux_loss_weight"
+MOE_AUX_LOSS_WEIGHT_DEFAULT = 1e-2
+# Router z-loss weight (mean(logsumexp(logits)^2) — logit drift guard).
+MOE_Z_LOSS_WEIGHT = "z_loss_weight"
+MOE_Z_LOSS_WEIGHT_DEFAULT = 1e-3
+# The `expert` mesh axis size (must divide num_experts AND the device
+# count alongside the other axes). 1 = no expert axis: experts run
+# data-parallel-replicated, no all-to-all (the dev/CI path).
+MOE_EXPERT_PARALLEL_SIZE = "expert_parallel_size"
+MOE_EXPERT_PARALLEL_SIZE_DEFAULT = 1
+
+#############################################
 # Mesh / parallelism (TPU-native extension keys)
 #############################################
 MESH = "mesh"

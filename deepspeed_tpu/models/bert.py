@@ -94,6 +94,11 @@ def bert_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: BertConfig,
         params["wpe"].astype(cfg.dtype)[None, :S]
     if cfg.type_vocab_size and segment_ids is not None:
         x = x + params["wse"].astype(cfg.dtype)[segment_ids]
+    if cfg.moe is not None:
+        raise NotImplementedError(
+            "MoE blocks are wired for the GPT-2 training path only "
+            "(models/gpt2.py threads the stats tuple); BERT keeps the "
+            "dense FFN")
     x = layer_norm(x, params["emb_ln_scale"], params["emb_ln_bias"],
                    cfg.layer_norm_eps)
     add_mask = None

@@ -77,19 +77,29 @@ def gpt2_param_shardings(cfg: GPT2Config, mp_axis: str = "model") -> Dict[str, A
 
 def gpt2_hidden(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
                 rng: Optional[jax.Array] = None, deterministic: bool = True,
-                attention_fn=None, pld_theta=None, zero3=None) -> jnp.ndarray:
+                attention_fn=None, pld_theta=None, zero3=None, mesh=None,
+                with_moe_stats: bool = False):
     """tokens [B, S] int32 → final hidden states [B, S, H] (post ln_f).
 
     ``zero3``: a bound ``Zero3Scan`` — the stacked block params arrive
     as ZeRO-3 dp shards and are gathered per layer inside the scan
-    (prefetch-overlapped); see models/transformer.apply_blocks."""
+    (prefetch-overlapped); see models/transformer.apply_blocks.
+
+    ``with_moe_stats=True`` returns ``(hidden, moe_stats_or_None)`` —
+    the training loss path consumes the stats; serving/eval callers
+    keep the plain return (the stats are dropped, the routed compute is
+    identical). ``mesh`` feeds the MoE ep > 1 shard_map."""
     B, S = tokens.shape
     x = params["wte"].astype(cfg.dtype)[tokens] + \
         params["wpe"].astype(cfg.dtype)[None, :S]
-    x = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
-                     deterministic=deterministic, attention_fn=attention_fn,
-                     pld_theta=pld_theta, zero3=zero3)
-    return layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
+    out = apply_blocks(params["blocks"], x, cfg, mask=None, rng=rng,
+                       deterministic=deterministic, attention_fn=attention_fn,
+                       pld_theta=pld_theta, zero3=zero3, mesh=mesh)
+    x, moe_stats = out if cfg.moe is not None else (out, None)
+    h = layer_norm_fn(cfg)(x, params["ln_f_scale"], params["ln_f_bias"])
+    if with_moe_stats:
+        return h, moe_stats
+    return h
 
 
 def gpt2_apply(params: Dict[str, Any], tokens: jnp.ndarray, cfg: GPT2Config,
@@ -132,7 +142,7 @@ def gpt2_logits_at(params: Dict[str, Any], tokens: jnp.ndarray,
     return h @ params["wte"].astype(h.dtype).T
 
 
-def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None, zero3=None):
+def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None, zero3=None, mesh=None):
     """Returns loss_fn(params, batch, rng) for the engine.
 
     batch: tokens [B, S+1] (inputs are [:, :-1], targets [:, 1:]) or a
@@ -146,21 +156,47 @@ def gpt2_loss_fn(cfg: GPT2Config, attention_fn=None, zero3=None):
     ``deepspeed_tpu.initialize(..., zero3_scan=...)`` — the engine binds
     the stage-3 layout at construction, the loss reads it at trace time
     and gathers the stacked block params per layer inside the scan.
+
+    ``cfg.moe``: the loss gains the weighted load-balance aux loss and
+    router z-loss, and the fn returns ``(loss, {"moe": stats})`` — the
+    engine rides the stats on the telemetry drain. ``mesh`` is required
+    when ``expert_parallel_size > 1`` (the all-to-all shard_map).
     """
     from ..ops.cross_entropy import chunked_softmax_xent
+
+    if cfg.moe is not None and cfg.moe.expert_parallel_size > 1 and \
+            mesh is None:
+        # Without the mesh the MoE layer would silently take its
+        # no-collective fallback inside the jit — GSPMD then all-gathers
+        # the full expert-sharded weight tree every step, the exact
+        # failure expert parallelism exists to avoid. The TRAINING entry
+        # point refuses; eval on fetched params (gpt2_apply) keeps the
+        # fallback.
+        raise ValueError(
+            "cfg.moe.expert_parallel_size > 1 requires "
+            "gpt2_loss_fn(cfg, mesh=mesh) — the all-to-all shard_map "
+            "cannot infer the mesh")
 
     def loss_fn(params, batch, rng, pld_theta=None):
         if isinstance(batch, (tuple, list)):
             tokens, targets = batch[0], batch[1]
         else:
             tokens, targets = batch[:, :-1], batch[:, 1:]
-        x = gpt2_hidden(params, tokens, cfg, rng=rng, deterministic=False,
-                        attention_fn=attention_fn, pld_theta=pld_theta,
-                        zero3=zero3)
+        x, moe_stats = gpt2_hidden(params, tokens, cfg, rng=rng,
+                                   deterministic=False,
+                                   attention_fn=attention_fn,
+                                   pld_theta=pld_theta, zero3=zero3,
+                                   mesh=mesh, with_moe_stats=True)
         B, S = tokens.shape
-        return chunked_softmax_xent(x.reshape(B * S, -1),
+        loss = chunked_softmax_xent(x.reshape(B * S, -1),
                                     params["wte"].astype(cfg.dtype),
                                     targets.reshape(-1))
+        if moe_stats is None:
+            return loss
+        moe = cfg.moe
+        loss = loss + moe.aux_loss_weight * moe_stats["aux_loss"] \
+            + moe.z_loss_weight * moe_stats["z_loss"]
+        return loss, {"moe": moe_stats}
     return loss_fn
 
 

@@ -126,6 +126,11 @@ def gpt2_pipe_spec(cfg: GPT2Config, rng=None, mp_axis: str = "model",
             shared["wpe"].astype(cfg.dtype)[None, :S]
 
     def stage_fn(blocks_local, x, rng):
+        if cfg.moe is not None:
+            raise NotImplementedError(
+                "MoE blocks do not compose with the pipeline stage path "
+                "yet (apply_blocks would return a stats tuple the stage "
+                "fn cannot thread) — ROADMAP item 4c")
         valid = None
         if stage_valid is not None:
             # Inside the shard_map'd pipe region: pick this stage's mask.

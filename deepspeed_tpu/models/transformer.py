@@ -66,6 +66,14 @@ class TransformerConfig:
     # True = erf-form GELU (HF BERT "gelu"); False = tanh approximation
     # (GPT-2 gelu_new, and what the reference's gelu_kernels.cu computes).
     gelu_exact: bool = False
+    # Mixture-of-Experts: a ``deepspeed_tpu.moe.MoEConfig`` swaps the
+    # dense FFN for the expert-parallel MoE FFN on every
+    # ``moe_layer_freq``-th block (freq 1 = every block — the only form
+    # the scanned layer stack supports; freq > 1 needs
+    # ``scan_layers=False``, since mixed block programs cannot share one
+    # scan body). None = dense everywhere (unchanged).
+    moe: Any = None
+    moe_layer_freq: int = 1
     # Fused elementwise Pallas kernels (ops/fused_elementwise): residual-
     # add+LayerNorm and the bias+GELU FFN epilogue. "auto" = on when the
     # backend is TPU (DS_FUSED_ELEMENTWISE=0/1 overrides); True/False
@@ -200,18 +208,22 @@ AttentionFn = Callable[..., jnp.ndarray]
 # --------------------------------------------------------------------- #
 def init_block_params(rng: jax.Array, cfg: TransformerConfig,
                       num_layers: Optional[int] = None) -> Dict[str, jnp.ndarray]:
-    """Initialize STACKED block params: every tensor has a leading [L] axis."""
+    """Initialize STACKED block params: every tensor has a leading layer
+    axis — [L] for the shared attention/LN tensors; with ``cfg.moe`` the
+    FFN tensors split into a dense stack ([n_dense]) and an expert stack
+    ([n_moe, E, ...]), each covering only its own layers (no dead
+    parameters on either side)."""
     L = num_layers if num_layers is not None else cfg.num_layers
     H, F = cfg.hidden_size, cfg.ffn_size
     std = cfg.initializer_range
     # GPT-2-style scaled init for residual-ending projections.
     proj_std = std / math.sqrt(2.0 * L)
-    ks = jax.random.split(rng, 4)
+    ks = jax.random.split(rng, 6)
 
     def norm(key, shape, s):
         return (jax.random.normal(key, shape, jnp.float32) * s)
 
-    return {
+    params = {
         "ln1_scale": jnp.ones((L, H), jnp.float32),
         "ln1_bias": jnp.zeros((L, H), jnp.float32),
         "qkv_kernel": norm(ks[0], (L, H, 3 * H), std),
@@ -220,11 +232,36 @@ def init_block_params(rng: jax.Array, cfg: TransformerConfig,
         "proj_bias": jnp.zeros((L, H), jnp.float32),
         "ln2_scale": jnp.ones((L, H), jnp.float32),
         "ln2_bias": jnp.zeros((L, H), jnp.float32),
-        "fc_kernel": norm(ks[2], (L, H, F), std),
-        "fc_bias": jnp.zeros((L, F), jnp.float32),
-        "fc_out_kernel": norm(ks[3], (L, F, H), proj_std),
-        "fc_out_bias": jnp.zeros((L, H), jnp.float32),
     }
+    if cfg.moe is None:
+        n_dense, n_moe = L, 0
+    else:
+        from ..moe.layer import moe_layer_indices
+        n_moe = len(moe_layer_indices(L, cfg.moe_layer_freq))
+        n_dense = L - n_moe
+        if n_moe == 0:
+            raise ValueError(
+                f"cfg.moe is set but moe_layer_freq={cfg.moe_layer_freq} "
+                f"selects no MoE layer out of {L} — use freq <= num_layers "
+                "or drop cfg.moe")
+    if n_dense > 0:
+        params.update({
+            "fc_kernel": norm(ks[2], (n_dense, H, F), std),
+            "fc_bias": jnp.zeros((n_dense, F), jnp.float32),
+            "fc_out_kernel": norm(ks[3], (n_dense, F, H), proj_std),
+            "fc_out_bias": jnp.zeros((n_dense, H), jnp.float32),
+        })
+    if n_moe > 0:
+        E = cfg.moe.num_experts
+        params.update({
+            "router_kernel": norm(ks[4], (n_moe, H, E), std),
+            "moe_fc_kernel": norm(ks[5], (n_moe, E, H, F), std),
+            "moe_fc_bias": jnp.zeros((n_moe, E, F), jnp.float32),
+            "moe_out_kernel": norm(
+                jax.random.fold_in(ks[5], 1), (n_moe, E, F, H), proj_std),
+            "moe_out_bias": jnp.zeros((n_moe, E, H), jnp.float32),
+        })
+    return params
 
 
 def block_param_shardings(mp_axis: str = "model") -> Dict[str, P]:
@@ -236,6 +273,9 @@ def block_param_shardings(mp_axis: str = "model") -> Dict[str, P]:
     hand-written Megatron pattern the reference's mpu contract assumes
     (engine.py:79-80).
     """
+    # Expert-FFN leaves (cfg.moe) get their specs from
+    # deepspeed_tpu.moe.sharding.expert_block_shardings (the `expert`
+    # axis on the E dim), merged by gpt2_moe_param_shardings.
     return {
         "ln1_scale": P(None, None), "ln1_bias": P(None, None),
         "qkv_kernel": P(None, None, mp_axis), "qkv_bias": P(None, mp_axis),
@@ -251,12 +291,19 @@ def transformer_block(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
                       mask: Optional[jnp.ndarray] = None,
                       rng: Optional[jax.Array] = None,
                       deterministic: bool = True,
-                      attention_fn: Optional[AttentionFn] = None) -> jnp.ndarray:
+                      attention_fn: Optional[AttentionFn] = None,
+                      mesh=None):
     """One (unstacked) block: params here have NO leading layer axis.
 
     Pre-LN (GPT-2/Megatron) or post-LN (original BERT) per
     cfg.pre_layer_norm — the reference's fused layer supports both
     (transformer.py:458-462 normalize_invertible interplay).
+
+    With ``cfg.moe`` the FFN sublayer routes through the expert-parallel
+    MoE FFN whenever this layer's params carry the expert tensors
+    (``moe_fc_kernel`` et al. — every ``moe_layer_freq``-th block), and
+    the block returns ``(x, moe_stats_or_None)`` instead of ``x``;
+    ``mesh`` feeds the ep > 1 all-to-all shard_map.
     """
     if attention_fn is None:
         from ..ops.flash_attention import auto_attention
@@ -297,14 +344,21 @@ def transformer_block(params: Dict[str, jnp.ndarray], x: jnp.ndarray,
         _, x = res_ln(x, attn, params["ln1_scale"], params["ln1_bias"])
         h = x
 
-    # --- FFN sublayer ---
-    h = gelu_up(h, params["fc_kernel"], params["fc_bias"])
-    h = dense(h, params["fc_out_kernel"], params["fc_out_bias"])
+    # --- FFN sublayer (dense, or the expert-parallel MoE FFN) ---
+    moe_stats = None
+    if "moe_fc_kernel" in params:
+        from ..moe.layer import moe_ffn
+        h, moe_stats = moe_ffn(params, h, cfg, mesh=mesh)
+    else:
+        h = gelu_up(h, params["fc_kernel"], params["fc_bias"])
+        h = dense(h, params["fc_out_kernel"], params["fc_out_bias"])
     h = dropout(h, cfg.hidden_dropout, r3, deterministic)
     if cfg.pre_layer_norm:
         x = x + h
     else:
         _, x = res_ln(x, h, params["ln2_scale"], params["ln2_bias"])
+    if cfg.moe is not None:
+        return x, moe_stats
     return x
 
 
@@ -340,8 +394,17 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
                  attention_fn: Optional[AttentionFn] = None,
                  pld_theta: Optional[jnp.ndarray] = None,
                  layer_valid: Optional[jnp.ndarray] = None,
-                 zero3=None) -> jnp.ndarray:
+                 zero3=None, mesh=None):
     """Run all L layers via lax.scan over the stacked leading axis.
+
+    With ``cfg.moe`` the return value is ``(x, moe_stats)`` — the
+    per-MoE-layer stats aggregated over layers (moe/layer.py), ``mesh``
+    feeding the ep > 1 all-to-all shard_map. MoE does not compose with
+    ``pld_theta``/``layer_valid`` (a skipped layer has no fixed-shape
+    stats) or the ``zero3`` layer scan (use the generic stage-3
+    leaf-at-use gather instead); ``moe_layer_freq > 1`` requires
+    ``scan_layers=False`` (mixed dense/MoE blocks cannot share one scan
+    body — the dense and expert FFN stacks cover different layers).
 
     ``zero3`` (a bound ``runtime.zero.stage3.Zero3Scan``) reroutes the
     layer loop through the ZeRO-3 prefetched scan: the stacked params
@@ -374,8 +437,33 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
         keys = jax.random.split(rng, L)
         use_rng = True
 
+    has_moe = cfg.moe is not None
+    if has_moe:
+        from ..moe.layer import (MOE_PARAM_KEYS, aggregate_moe_stats,
+                                 moe_layer_indices)
+        moe_layers = moe_layer_indices(L, cfg.moe_layer_freq)
+        if not moe_layers:
+            raise ValueError(
+                f"cfg.moe is set but moe_layer_freq={cfg.moe_layer_freq} "
+                f"selects no MoE layer out of {L}")
+        if pld_theta is not None or layer_valid is not None:
+            raise ValueError(
+                "moe blocks do not compose with progressive layer drop "
+                "or padded layer_valid slots (a skipped layer has no "
+                "fixed-shape expert stats)")
+        if zero3 is not None and getattr(zero3, "bound", False):
+            raise ValueError(
+                "moe blocks do not compose with the zero3 layer scan — "
+                "use the generic stage-3 leaf-at-use gather (no "
+                "zero3_scan)")
+        if cfg.scan_layers and len(moe_layers) != L:
+            raise ValueError(
+                "moe_layer_freq > 1 requires scan_layers=False (mixed "
+                "dense/MoE blocks cannot share one scan body)")
+
     block = partial(transformer_block, cfg=cfg, mask=mask,
-                    deterministic=deterministic, attention_fn=attention_fn)
+                    deterministic=deterministic, attention_fn=attention_fn,
+                    mesh=mesh)
 
     if zero3 is not None and getattr(zero3, "bound", False):
         if pld_theta is not None or layer_valid is not None:
@@ -414,24 +502,58 @@ def apply_blocks(stacked: Dict[str, jnp.ndarray], x: jnp.ndarray,
                         lambda hh: hh, h)
 
     if not cfg.scan_layers:
+        stats_list = []
+        if has_moe:
+            moe_pos = {li: p for p, li in enumerate(moe_layers)}
+            dense_pos = {li: p for p, li in enumerate(
+                i for i in range(L) if i not in moe_pos)}
+            ffn_keys = MOE_PARAM_KEYS | {"fc_kernel", "fc_bias",
+                                         "fc_out_kernel", "fc_out_bias"}
         for i in range(L):
-            p_i = jax.tree_util.tree_map(lambda t: t[i], stacked)
+            if not has_moe:
+                p_i = jax.tree_util.tree_map(lambda t: t[i], stacked)
+            else:
+                # Dense and expert FFN stacks cover DIFFERENT layer
+                # subsets; slice each key group at its own position.
+                p_i = {}
+                for name, t in stacked.items():
+                    if name not in ffn_keys:
+                        p_i[name] = t[i]
+                    elif name in MOE_PARAM_KEYS:
+                        if i in moe_pos:
+                            p_i[name] = t[moe_pos[i]]
+                    elif i in dense_pos:
+                        p_i[name] = t[dense_pos[i]]
             v_i = None if layer_valid is None else layer_valid[i]
-            x = maybe_dropped(p_i, x, keys[i], jnp.asarray(i), v_i)
+            out = maybe_dropped(p_i, x, keys[i], jnp.asarray(i), v_i)
+            if has_moe:
+                x, st = out
+                if st is not None:
+                    stats_list.append(st)
+            else:
+                x = out
+        if has_moe:
+            stacked_stats = jax.tree_util.tree_map(
+                lambda *a: jnp.stack(a), *stats_list)
+            return x, aggregate_moe_stats(stacked_stats)
         return x
 
     def body(h, layer):
         if layer_valid is None:
             p, key, idx = layer
-            h = maybe_dropped(p, h, key, idx, None)
+            out = maybe_dropped(p, h, key, idx, None)
         else:
             p, key, idx, v = layer
-            h = maybe_dropped(p, h, key, idx, v)
-        return h, None
+            out = maybe_dropped(p, h, key, idx, v)
+        if has_moe:
+            return out[0], out[1]
+        return out, None
 
     xs = (stacked, keys, jnp.arange(L)) if layer_valid is None else \
         (stacked, keys, jnp.arange(L), layer_valid)
-    x, _ = lax.scan(body, x, xs)
+    x, ys = lax.scan(body, x, xs)
+    if has_moe:
+        return x, aggregate_moe_stats(ys)
     return x
 
 

@@ -1,0 +1,19 @@
+"""Mixture-of-Experts expert parallelism (post-dates the reference).
+
+Top-k gated expert FFN sharded over the ``expert`` mesh axis
+(parallel/topology.EP_AXIS) with capacity-factor token bucketing and
+``lax.all_to_all`` dispatch/combine — the DeepSpeed-MoE design
+(Rajbhandari et al., 2022) expressed TPU-natively: one compiled shape
+regardless of routing, collectives emitted by construction under
+shard_map, expert weights born sharded via PartitionSpecs.
+"""
+from .layer import (MoEConfig, expert_capacity, moe_ffn, moe_layer_indices,
+                    router_topk, MOE_PARAM_KEYS)
+from .sharding import (expert_block_shardings, gpt2_moe_param_shardings,
+                       is_expert_spec)
+
+__all__ = [
+    "MoEConfig", "expert_capacity", "moe_ffn", "moe_layer_indices",
+    "router_topk", "MOE_PARAM_KEYS",
+    "expert_block_shardings", "gpt2_moe_param_shardings", "is_expert_spec",
+]

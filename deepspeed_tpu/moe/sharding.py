@@ -1,0 +1,88 @@
+"""Expert parameter placement: born sharded over the ``expert`` axis.
+
+Expert FFN weights carry a stacked layout ``[n_moe_layers, E, ...]``
+(models/transformer.init_block_params); their PartitionSpecs put the
+``expert`` mesh axis on the E dim, so each expert group owns exactly its
+E/ep experts from birth — no gather ever materializes the full expert
+tree. The router is replicated (every token routes against all E
+logits).
+
+Composition story (what follows from handing these specs to
+``deepspeed_tpu.initialize(param_shardings=...)``):
+
+- **Grads** follow automatically: the MoE shard_map's transpose psums
+  expert-weight cotangents over ``data`` ONLY (within-expert-group
+  sync), and ``runtime/zero/partition.grad_shardings`` layers the ZeRO
+  dp axis onto the expert base spec's first free divisible dim — so
+  under stage >= 2 the expert grads land data-sharded *within* their
+  expert shard, never replicated across experts.
+- **Moments/masters** mirror the same base via ``zero_shardings`` /
+  ``stage3_param_specs`` (the param-structured-subtree rule), keeping
+  the optimizer apply element-aligned and shard-local on the dense AND
+  expert trees alike. ZeRO stages 1-3 on the dense tree are untouched —
+  the expert axis factors out of data, so the dense leaves still shard
+  over ``data`` exactly as before.
+- **Fused optimizer**: engines built with ``param_shardings`` route the
+  optax per-leaf apply — the fused multi-tensor front end's flat
+  V-interleaved chunks are laid out over the dp axis and concatenating
+  an expert-sharded leaf into them would silently all-gather it every
+  step (the same reason TP layouts fall back; runtime/engine.py logs
+  the downgrade). The per-leaf apply stays shard-local on the declared
+  layout.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from jax.sharding import PartitionSpec as P
+
+from ..parallel.topology import EP_AXIS
+
+
+def is_expert_spec(spec: P, ep_axis: str = EP_AXIS) -> bool:
+    """True when a PartitionSpec places any dim on the expert axis."""
+    for entry in spec:
+        if entry == ep_axis or (isinstance(entry, (tuple, list)) and
+                                ep_axis in entry):
+            return True
+    return False
+
+
+def expert_block_shardings(ep: int, ep_axis: str = EP_AXIS
+                           ) -> Dict[str, P]:
+    """Specs for the stacked MoE block params ([n_moe, E, ...] leaves).
+
+    ep == 1 keeps everything replicated (a single expert group — the
+    dev/CI path with no expert axis live)."""
+    e = ep_axis if ep > 1 else None
+    return {
+        "router_kernel": P(None, None, None),       # [n_moe, H, E]
+        "moe_fc_kernel": P(None, e, None, None),    # [n_moe, E, H, F]
+        "moe_fc_bias": P(None, e, None),            # [n_moe, E, F]
+        "moe_out_kernel": P(None, e, None, None),   # [n_moe, E, F, H]
+        "moe_out_bias": P(None, e, None),           # [n_moe, E, H]
+    }
+
+
+def gpt2_moe_param_shardings(cfg, mp_axis: str = "model",
+                             ep_axis: str = EP_AXIS) -> Dict[str, Any]:
+    """The gpt2 spec tree with the expert overrides merged in — pass as
+    ``initialize(param_shardings=...)`` for an MoE GPT-2."""
+    from ..models.gpt2 import gpt2_param_shardings
+    assert cfg.moe is not None, "cfg.moe is None — not an MoE config"
+    specs = gpt2_param_shardings(cfg, mp_axis)
+    blocks = dict(specs["blocks"])
+    moe = expert_block_shardings(cfg.moe.expert_parallel_size, ep_axis)
+    n_dense = cfg.num_layers - len(
+        _moe_layers(cfg.num_layers, cfg.moe_layer_freq))
+    if n_dense == 0:
+        for k in ("fc_kernel", "fc_bias", "fc_out_kernel", "fc_out_bias"):
+            blocks.pop(k, None)
+    blocks.update(moe)
+    specs["blocks"] = blocks
+    return specs
+
+
+def _moe_layers(num_layers: int, freq: int):
+    from .layer import moe_layer_indices
+    return moe_layer_indices(num_layers, freq)

@@ -522,6 +522,12 @@ class Telemetry:
                                 else v)
             for k, v in host_fields.items():
                 rec[k] = _to_py(v) if not isinstance(v, dict) else v
+            # MoE per-expert routed token counts ride as one [E] array
+            # (fetched in the same batched device_get) — JSON-listify.
+            moe_tokens = rec.get("moe_expert_tokens")
+            if isinstance(moe_tokens, np.ndarray):
+                rec["moe_expert_tokens"] = [
+                    round(float(t), 2) for t in moe_tokens.reshape(-1)]
             # The in-graph health tap (already fetched in THE batched
             # device_get above) feeds provenance, not the JSONL record.
             leaf_sq = rec.pop(HEALTH_TAP_KEY, None)

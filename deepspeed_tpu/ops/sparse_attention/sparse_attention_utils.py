@@ -127,6 +127,10 @@ class SparseAttentionUtils:
 
         def encoder_fn(params, hidden_states, key_padding_mask=None,
                        rng=None, deterministic=True):
+            if cfg.moe is not None:
+                raise NotImplementedError(
+                    "MoE blocks are not supported on the sparse-"
+                    "attention encoder path (dense FFN only)")
             mask = None
             if key_padding_mask is not None:
                 pad = 1.0 - key_padding_mask.astype(jnp.float32)

@@ -145,9 +145,23 @@ def all_gather(x: Any, axis_name: str, axis: int = 0, tiled: bool = True) -> Any
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
-def all_to_all(x: Any, axis_name: str, split_axis: int, concat_axis: int) -> Any:
-    return lax.all_to_all(x, axis_name, split_axis=split_axis,
-                          concat_axis=concat_axis, tiled=True)
+def all_to_all(x: Any, axis_name: str, split_axis: int, concat_axis: int,
+               tiled: bool = True) -> Any:
+    """Exchange: split ``split_axis`` across the axis group, concatenate
+    the received pieces on ``concat_axis``. Tiled (the default) keeps the
+    rank; untiled requires ``split_axis`` to equal the axis size and
+    unstacks it. Applied twice with ``split_axis == concat_axis`` it is
+    an involution — the identity the MoE combine path relies on
+    (deepspeed_tpu/moe/layer.py).
+
+    The operand is marked varying over the axis first (``pvary`` — the
+    same shard_map rep-checker shim its collective siblings got):
+    new-jax's vma analysis requires an all-to-all input to be
+    per-member-varying, and a replicated-marked operand would be
+    rejected; on old jax the marking is identity."""
+    return lax.all_to_all(pvary(x, axis_name), axis_name,
+                          split_axis=split_axis, concat_axis=concat_axis,
+                          tiled=tiled)
 
 
 def broadcast(x: Any, axis_name: str, src: int = 0) -> Any:

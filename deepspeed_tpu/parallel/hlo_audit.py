@@ -55,7 +55,8 @@ from ..analysis.hlo_text import (
 __all__ = [
     "CollectiveOp", "CommAudit", "parse_hlo_collectives", "audit_text",
     "audit_jit", "ring_wire_bytes", "zero2_grad_sync_lowering",
-    "grad_sync_wire_model", "DTYPE_BYTES", "while_trip_counts",
+    "grad_sync_wire_model", "moe_alltoall_wire_model", "DTYPE_BYTES",
+    "while_trip_counts",
 ]
 
 COLLECTIVE_KINDS = ("all-reduce", "reduce-scatter", "all-gather",
@@ -305,13 +306,66 @@ def zero2_grad_sync_lowering(mesh, axis_name: str = "data",
     return result
 
 
+def moe_alltoall_wire_model(hidden: int, num_experts: int, top_k: int,
+                            capacity_factor: float, ep: int,
+                            n_moe_layers: int = 1, bytes_per_el: int = 4,
+                            tokens_per_device: Optional[int] = None,
+                            gas: int = 1) -> Dict[str, Any]:
+    """Analytic per-device wire bytes of the MoE dispatch/combine
+    all-to-alls (deepspeed_tpu/moe/layer.py) per optimizer step.
+
+    Each MoE layer exchanges its ``[E, C, H]`` dispatch buffer over the
+    ``expert`` axis FOUR times per micro-step — forward dispatch +
+    combine, and their transposes in backward (the vjp of an all-to-all
+    is an all-to-all) — each moving ``(ep-1)/ep`` of the buffer off-chip
+    under the ring model (tools/comm_audit.py checks the compiled
+    program against this to 5%).
+
+    With ``tokens_per_device`` (T per micro-step) the figure is exact at
+    the capacity rounding (C = ceil(cf·k·T/E)); without it only the
+    T-free ``wire_bytes_per_token`` is reported (≈ 4·n·(ep-1)/ep·cf·k·
+    H·bytes — the capacity ceil amortizes away). ep <= 1 prices to zero
+    (no collective exists)."""
+    out: Dict[str, Any] = {
+        "ep": ep, "num_experts": num_experts, "top_k": top_k,
+        "capacity_factor": capacity_factor, "n_moe_layers": n_moe_layers,
+        "alltoalls_per_moe_layer_per_micro_step": 4,
+        "bytes_per_el": int(bytes_per_el),
+    }
+    if ep <= 1:
+        out.update({"wire_bytes_per_token": 0, "wire_bytes_per_step": 0})
+        return out
+    frac = (ep - 1) / ep
+    import math as _math
+    if _math.isinf(capacity_factor):
+        per_token = 4 * n_moe_layers * frac * num_experts * hidden * \
+            bytes_per_el
+    else:
+        per_token = 4 * n_moe_layers * frac * capacity_factor * top_k * \
+            hidden * bytes_per_el
+    out["wire_bytes_per_token"] = int(per_token)
+    if tokens_per_device is not None:
+        from ..moe.layer import expert_capacity
+        c = expert_capacity(int(tokens_per_device), num_experts, top_k,
+                            capacity_factor)
+        buf = num_experts * c * hidden * bytes_per_el
+        out["capacity"] = c
+        out["dispatch_buffer_bytes"] = int(buf)
+        out["wire_bytes_per_step"] = int(
+            4 * n_moe_layers * int(gas) * ring_wire_bytes(
+                "all-to-all", buf, ep))
+    return out
+
+
 def grad_sync_wire_model(params: Any, dp: int,
                          grad_bytes_per_el: int = 4,
                          zero3: bool = False,
                          param_bytes_per_el: Optional[int] = None,
                          gas: int = 1,
                          param_specs: Any = None,
-                         mesh: Any = None) -> Dict[str, int]:
+                         mesh: Any = None,
+                         moe: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
     """Analytic per-step gradient-sync wire bytes for a param tree under
     dp-way data parallelism, in both lowerings. Scatterable leaves follow
     zero/partition.py's rule (first dim >= dp and divisible); the rest are
@@ -334,6 +388,13 @@ def grad_sync_wire_model(params: Any, dp: int,
     pass ``mesh`` with it so a dp+TP leaf is priced at its per-TP-rank
     slice (the dp collective moves 1/mp of the leaf per rank, and the
     dp gather reconstructs 1/mp per device, not the full leaf).
+
+    ``moe``: kwargs for ``moe_alltoall_wire_model`` — when given, the
+    output grows ``moe_alltoall_wire_bytes`` (the per-step priced
+    dispatch/combine all-to-all term) and the full ``moe`` sub-record.
+    The term is reported separately, NOT folded into the grad-sync
+    figures: it is activation wire, and the engine sums the two for its
+    per-step total.
     """
     import jax
     from .topology import DP_AXIS
@@ -401,4 +462,9 @@ def grad_sync_wire_model(params: Any, dp: int,
                 int(gas) * (out["reduce_scatter_wire_bytes"]
                             + 2 * one_gather),
         })
+    if moe is not None:
+        m = moe_alltoall_wire_model(**moe)
+        out["moe"] = m
+        out["moe_alltoall_wire_bytes"] = int(
+            m.get("wire_bytes_per_step") or 0)
     return out

@@ -120,15 +120,28 @@ DP_AXIS = "data"
 MP_AXIS = "model"
 PP_AXIS = "pipe"
 SP_AXIS = "seq"
+# Expert parallelism (MoE): the `expert` axis FACTORS OUT OF data — it
+# reuses the data-parallel devices, so the batch shards over
+# (expert, data) jointly and the total replica count is ep * dp. Expert
+# FFN weights shard over `expert` (each group owns E/ep experts) and
+# their grads sync over `data` WITHIN an expert group only; the MoE
+# all-to-all dispatch/combine rides this axis (deepspeed_tpu/moe/).
+EP_AXIS = "expert"
 
 
 def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
-               devices=None, axis_order: Tuple[str, ...] = (PP_AXIS, DP_AXIS, SP_AXIS, MP_AXIS)):
+               ep: int = 1, devices=None,
+               axis_order: Tuple[str, ...] = (PP_AXIS, EP_AXIS, DP_AXIS,
+                                              SP_AXIS, MP_AXIS)):
     """Build a ``jax.sharding.Mesh`` with named axes over available devices.
 
     dp=None infers the remainder of the device count. Axis order places mp
     innermost (fastest-varying) for the shortest ICI hops, pp outermost; this
     mirrors PipeModelDataParallelTopology's (pipe, data, model) rank order.
+    ``ep`` (expert parallelism) sits just OUTSIDE data: expert factors out
+    of the dp device set, so the all-to-all groups are dp-stride
+    neighborhoods and a (expert, data)-sharded batch enumerates the same
+    global order the plain dp mesh used.
     """
     import jax
     from jax.sharding import Mesh
@@ -137,10 +150,11 @@ def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        denom = mp * pp * sp
-        assert n % denom == 0, f"{n} devices not divisible by mp*pp*sp={denom}"
+        denom = mp * pp * sp * ep
+        assert n % denom == 0, \
+            f"{n} devices not divisible by mp*pp*sp*ep={denom}"
         dp = n // denom
-    sizes = {PP_AXIS: pp, DP_AXIS: dp, SP_AXIS: sp, MP_AXIS: mp}
+    sizes = {PP_AXIS: pp, EP_AXIS: ep, DP_AXIS: dp, SP_AXIS: sp, MP_AXIS: mp}
     total = int(np.prod(list(sizes.values())))
     assert total == n, f"mesh {sizes} needs {total} devices, have {n}"
     shape = tuple(sizes[a] for a in axis_order)

@@ -322,6 +322,75 @@ class InferenceConfig:
                 f"label, got {self.replica!r}")
 
 
+class MoeConfig:
+    """The ``moe`` block (deepspeed_tpu/moe/ expert parallelism).
+
+    ``num_experts == 0`` (the default) leaves the block inert. The
+    engine reads it for the `expert` mesh axis, the MoE metrics schema,
+    and the all-to-all wire model; build the model's
+    ``TransformerConfig.moe`` from it via ``MoEConfig.from_ds_config``.
+    """
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.MOE, {})
+        get = config_utils.get_scalar_param
+        self.num_experts = get(d, C.MOE_NUM_EXPERTS,
+                               C.MOE_NUM_EXPERTS_DEFAULT)
+        self.top_k = get(d, C.MOE_TOP_K, C.MOE_TOP_K_DEFAULT)
+        self.capacity_factor = get(d, C.MOE_CAPACITY_FACTOR,
+                                   C.MOE_CAPACITY_FACTOR_DEFAULT)
+        self.aux_loss_weight = get(d, C.MOE_AUX_LOSS_WEIGHT,
+                                   C.MOE_AUX_LOSS_WEIGHT_DEFAULT)
+        self.z_loss_weight = get(d, C.MOE_Z_LOSS_WEIGHT,
+                                 C.MOE_Z_LOSS_WEIGHT_DEFAULT)
+        self.expert_parallel_size = get(d, C.MOE_EXPERT_PARALLEL_SIZE,
+                                        C.MOE_EXPERT_PARALLEL_SIZE_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        blk = C.MOE
+        if not isinstance(self.num_experts, int) or self.num_experts < 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_NUM_EXPERTS} must be a non-negative int "
+                f"(0 = disabled), got {self.num_experts!r}")
+        if not isinstance(self.expert_parallel_size, int) or \
+                self.expert_parallel_size < 1:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_EXPERT_PARALLEL_SIZE} must be a positive "
+                f"int, got {self.expert_parallel_size!r}")
+        if self.num_experts == 0:
+            if self.expert_parallel_size > 1:
+                raise DeepSpeedConfigError(
+                    f"{blk}.{C.MOE_EXPERT_PARALLEL_SIZE} > 1 needs "
+                    f"{C.MOE_NUM_EXPERTS} > 0")
+            return
+        if self.top_k not in (1, 2):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_TOP_K} must be 1 or 2, got {self.top_k!r}")
+        if self.top_k > self.num_experts:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_TOP_K}={self.top_k} exceeds "
+                f"{C.MOE_NUM_EXPERTS}={self.num_experts}")
+        cf = self.capacity_factor
+        if isinstance(cf, bool) or not isinstance(cf, (int, float)) or \
+                not cf > 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_CAPACITY_FACTOR} must be a positive "
+                f"number (inf = never drop), got {cf!r}")
+        for name, v in ((C.MOE_AUX_LOSS_WEIGHT, self.aux_loss_weight),
+                        (C.MOE_Z_LOSS_WEIGHT, self.z_loss_weight)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)) \
+                    or v < 0:
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a non-negative number, "
+                    f"got {v!r}")
+        if self.num_experts % self.expert_parallel_size != 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_NUM_EXPERTS}={self.num_experts} not "
+                f"divisible by {C.MOE_EXPERT_PARALLEL_SIZE}="
+                f"{self.expert_parallel_size}")
+
+
 class MeshConfig:
     """TPU-native extension: requested logical mesh axis sizes.
 
@@ -443,6 +512,7 @@ class DeepSpeedConfig:
             d, tensorboard=self.tensorboard_config)
         self.inference_config = InferenceConfig(d)
         self.mesh_config = MeshConfig(d)
+        self.moe_config = MoeConfig(d)
 
         fp16 = d.get(C.FP16, {})
         self.fp16_enabled = get(fp16, C.FP16_ENABLED, C.FP16_ENABLED_DEFAULT)

@@ -56,7 +56,7 @@ from ..monitor import Telemetry
 from ..monitor.memory import analytic_state_bytes
 from ..ops.optimizers import build_optimizer
 from ..parallel import comm
-from ..parallel.topology import build_mesh, DP_AXIS, MP_AXIS
+from ..parallel.topology import build_mesh, DP_AXIS, EP_AXIS, MP_AXIS
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -104,17 +104,18 @@ def _make_raw_scaled_loss(loss_fn, accepts_pld: bool, gas: int):
     """The scaled-loss core every grad builder shares: params arrive
     already in compute form (cast cache / the stage-3 gather's in-flight
     cast / the caller's _cast_floats wrapper). Returns
-    ``(scaled_loss_for_backward, raw_loss)`` — scaled for the fp16
-    backward, divided by gas so accumulation averages. ONE definition so
-    the main, trio, and offload paths cannot diverge on the scaling
-    semantics."""
+    ``(scaled_loss_for_backward, (raw_loss, aux))`` — scaled for the
+    fp16 backward, divided by gas so accumulation averages; ``aux`` is
+    the loss_fn's auxiliary output (None for plain-loss models — the MoE
+    stats dict rides here). ONE definition so the main, trio, and
+    offload paths cannot diverge on the scaling semantics."""
     import jax.numpy as _jnp
 
     def raw_scaled_loss(cparams, mb, key, scale, theta):
         out = loss_fn(cparams, mb, key, pld_theta=theta) if accepts_pld \
             else loss_fn(cparams, mb, key)
-        loss, _ = (out if isinstance(out, tuple) else (out, None))
-        return (loss.astype(_jnp.float32) * scale) / gas, loss
+        loss, aux = (out if isinstance(out, tuple) else (out, None))
+        return (loss.astype(_jnp.float32) * scale) / gas, (loss, aux)
     return raw_scaled_loss
 
 
@@ -242,9 +243,30 @@ class DeepSpeedEngine:
         self.mpu = mpu
         self.mesh = mesh if mesh is not None else self._build_mesh(config)
         self.dp_size = int(self.mesh.shape.get(DP_AXIS, 1))
+        # MoE expert parallelism: the `expert` axis factors OUT OF data
+        # (it reuses the dp devices), so the batch-replica count — the
+        # world size the batch solver and throughput accounting see — is
+        # ep * dp, while ZeRO keeps sharding over `data` (within-expert-
+        # group) and expert weights shard over `expert`.
+        self.ep_size = int(self.mesh.shape.get(EP_AXIS, 1))
+        self.replica_size = self.dp_size * self.ep_size
 
-        self.config = DeepSpeedConfig(config, mpu=mpu, world_size=self.dp_size) \
+        self.config = DeepSpeedConfig(config, mpu=mpu,
+                                      world_size=self.replica_size) \
             if not isinstance(config, DeepSpeedConfig) else config
+        # The `moe` ds_config block: engine-side expert-parallel truth
+        # (mesh axis, metrics schema, wire model). The MODEL is built
+        # separately (TransformerConfig.moe) — the train step validates
+        # at trace time that a configured block actually has an MoE
+        # model behind it.
+        self._moe = self.config.moe_config \
+            if self.config.moe_config.num_experts > 0 else None
+        if self._moe is not None and \
+                self._moe.expert_parallel_size != self.ep_size:
+            raise ValueError(
+                f"moe.expert_parallel_size={self._moe.expert_parallel_size}"
+                f" but the mesh '{EP_AXIS}' axis has size {self.ep_size} —"
+                " build the mesh with build_mesh(ep=...) to match")
         self._validate_engine_config()
 
         self.loss_fn, init_params = self._normalize_model(model, model_params)
@@ -562,7 +584,8 @@ class DeepSpeedEngine:
         # Observability.
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
-            batch_size=self.train_micro_batch_size_per_gpu() * self.dp_size,
+            batch_size=self.train_micro_batch_size_per_gpu() *
+            self.replica_size,
             start_step=2, steps_per_output=self.steps_per_print(),
             synchronized=self.wall_clock_breakdown())
 
@@ -597,6 +620,17 @@ class DeepSpeedEngine:
         self._prefetch_depth = int(self.config.zero_config.prefetch_depth)
         if self._zero3 and zero3_scan is not None:
             self._bind_zero3_scan(zero3_scan)
+        # MoE all-to-all pricing needs the per-device token count, which
+        # only the first batch reveals (_maybe_refresh_moe_wire).
+        self._moe_tokens_per_device = None
+        if self._moe is not None and self.ep_size > 1 and \
+                self._param_specs is None:
+            logger.warning(
+                "moe.expert_parallel_size > 1 without param_shardings: "
+                "expert weights stay replicated on every device — pass "
+                "deepspeed_tpu.moe.sharding specs (e.g. "
+                "gpt2_moe_param_shardings) to born-shard them over the "
+                "expert axis")
         self._wire_bytes, self._wire_detail = self._grad_wire_bytes()
         self._log_comm_plan()
 
@@ -609,6 +643,7 @@ class DeepSpeedEngine:
             default_report_steps=self.steps_per_print(),
             meta=dict(
                 dp=self.dp_size,
+                ep=self.ep_size,
                 zero_stage=self.zero_optimization_stage(),
                 precision=self.config.precision_dtype,
                 cpu_offload=self._offload is not None,
@@ -617,7 +652,13 @@ class DeepSpeedEngine:
                 wire_detail=self._wire_detail,
                 train_batch_size=self.train_batch_size(),
                 gradient_accumulation_steps=
-                self.gradient_accumulation_steps()))
+                self.gradient_accumulation_steps(),
+                **({"moe": dict(
+                    num_experts=self._moe.num_experts,
+                    top_k=self._moe.top_k,
+                    capacity_factor=self._moe.capacity_factor,
+                    expert_parallel_size=self.ep_size)}
+                   if self._moe is not None else {})))
         # Weakref, not a bound closure: the Telemetry outlives engines via
         # its atexit flush hook, and a strong closure here would pin the
         # engine's entire device state for process lifetime.
@@ -677,7 +718,7 @@ class DeepSpeedEngine:
     # Construction helpers
     # ------------------------------------------------------------------ #
     def _build_mesh(self, config) -> Mesh:
-        mp = pp = sp = 1
+        mp = pp = sp = ep = 1
         if isinstance(config, str):
             from .config_utils import load_config_json
             config = load_config_json(config)
@@ -685,12 +726,15 @@ class DeepSpeedEngine:
             mc = config.mesh_config
             mp, pp, sp = (mc.model_parallel_size or 1, mc.pipe_parallel_size or 1,
                           mc.sequence_parallel_size or 1)
+            ep = config.moe_config.expert_parallel_size or 1
         elif isinstance(config, dict):
             mesh_cfg = config.get(C.MESH, {})
             mp = mesh_cfg.get(C.MESH_MODEL_PARALLEL_SIZE, 1) or 1
             pp = mesh_cfg.get(C.MESH_PIPE_PARALLEL_SIZE, 1) or 1
             sp = mesh_cfg.get(C.MESH_SEQUENCE_PARALLEL_SIZE, 1) or 1
-        return build_mesh(mp=mp, pp=pp, sp=sp)
+            ep = config.get(C.MOE, {}).get(
+                C.MOE_EXPERT_PARALLEL_SIZE, 1) or 1
+        return build_mesh(mp=mp, pp=pp, sp=sp, ep=ep)
 
     def _validate_engine_config(self) -> None:
         # Stage 3 (parameter partitioning) goes PAST the reference, which
@@ -703,6 +747,24 @@ class DeepSpeedEngine:
                 "ZeRO stage 3 does not compose with pipeline grads_fn "
                 "(1F1B computes grads inside its own primal scan); use "
                 "stage <= 2 with the pipeline engine")
+        if self.ep_size > 1:
+            # Expert parallelism composes with the MAIN train path: the
+            # paths below run their own shard_maps/autodiff over `data`
+            # only and would silently mis-shard the (expert, data) batch.
+            blockers = []
+            if self._direct_grads_fn is not None:
+                blockers.append("pipeline grads_fn (1F1B)")
+            if self.config.zero_config.cpu_offload:
+                blockers.append("zero_optimization.cpu_offload")
+            if self.config.sparse_gradients_enabled:
+                blockers.append("sparse_gradients")
+            if (self.config.optimizer_name or "").lower() == \
+                    C.ONEBIT_ADAM_OPTIMIZER:
+                blockers.append("OnebitAdam")
+            if blockers:
+                raise ValueError(
+                    "moe expert_parallel_size > 1 composes with the main "
+                    f"train path only; drop {', '.join(blockers)}")
 
     def _normalize_model(self, model, model_params) -> Tuple[Callable, Any]:
         """Accept a flax module or a loss callable; return loss_fn(params,
@@ -828,9 +890,11 @@ class DeepSpeedEngine:
         actually runs. One source of truth for the init log, the
         telemetry meta/records, and bench's dp_comm provenance."""
         self._wire_model = None
-        if self.dp_size <= 1:
+        if self.replica_size <= 1:
             return 0, "single replica (no gradient sync)"
         from ..parallel import hlo_audit
+        if self.ep_size > 1:
+            return self._moe_wire_bytes(hlo_audit)
         if self._sparse_mask is not None:
             # Sparse embedding grads travel the data-dependent CSR
             # exchange (volume ~ nnz_rows/vocab of dense; see
@@ -887,6 +951,133 @@ class DeepSpeedEngine:
             (f"{mode} reduce-scatter (declared sharding "
              f"lowers to {declared} on this backend)")
 
+    def _moe_layer_info(self) -> Tuple[int, int]:
+        """(n_moe_layers, hidden) read off the expert up-projection leaf
+        (path ``moe_fc_kernel``, stacked [n_moe, E, H, F]); (0, 0) when
+        the param tree carries none."""
+        flat, _ = jax.tree_util.tree_flatten_with_path(self.state.params)
+        for path, leaf in flat:
+            if "moe_fc_kernel" in jax.tree_util.keystr(path) and \
+                    getattr(leaf, "ndim", 0) == 4:
+                return int(leaf.shape[0]), int(leaf.shape[2])
+        return 0, 0
+
+    def _moe_wire_bytes(self, hlo_audit) -> Tuple[int, str]:
+        """Expert-parallel (ep > 1) wire model:
+
+        - DENSE leaves sync over the full ep x dp replica set (under
+          ZeRO >= 2: all-reduce across expert groups + reduce-scatter
+          within data — the declared dp shard);
+        - EXPERT leaves (param spec on the `expert` axis) all-reduce
+          their 1/ep shard over `data` ONLY — the moe shard_map
+          transpose's within-expert-group psum; they are never
+          replicated across experts;
+        - the dispatch/combine all-to-alls price per token
+          (hlo_audit.moe_alltoall_wire_model); the exact per-step figure
+          resolves at the first batch (_maybe_refresh_moe_wire), when
+          the engine learns the token count.
+        """
+        from ..moe.sharding import is_expert_spec
+        ring = hlo_audit.ring_wire_bytes
+        leaves = jax.tree_util.tree_leaves(self.state.params)
+        if self._param_specs is not None:
+            spec_leaves = jax.tree_util.tree_structure(
+                self.state.params).flatten_up_to(self._param_specs)
+        else:
+            spec_leaves = [P()] * len(leaves)
+        mask = [isinstance(sp, P) and is_expert_spec(sp)
+                for sp in spec_leaves]
+        dense_leaves = [l for l, m in zip(leaves, mask) if not m]
+        expert_full = sum(int(np.prod(l.shape)) * 4
+                          for l, m in zip(leaves, mask)
+                          if m and hasattr(l, "shape"))
+        expert_local = expert_full // self.ep_size
+        n_moe, hidden = self._moe_layer_info()
+        moe_kw = dict(
+            hidden=hidden, num_experts=self._moe.num_experts,
+            top_k=self._moe.top_k,
+            capacity_factor=self._moe.capacity_factor,
+            ep=self.ep_size, n_moe_layers=max(1, n_moe),
+            bytes_per_el=jnp.dtype(self.compute_dtype).itemsize,
+            tokens_per_device=self._moe_tokens_per_device,
+            gas=self._scan_microbatches())
+        model = dict(hlo_audit.grad_sync_wire_model(
+            dense_leaves, self.dp_size, moe=moe_kw))
+        stage2_rs = self.zero_optimization_stage() >= 2 and \
+            self._grad_sync_mode in ("declarative", "explicit")
+        if stage2_rs and self.dp_size > 1:
+            dense_wire = (
+                ring("all-reduce", model["scatterable_bytes"],
+                     self.ep_size)
+                + ring("reduce-scatter", model["scatterable_bytes"],
+                       self.dp_size)
+                + ring("all-reduce", model["replicated_bytes"],
+                       self.replica_size))
+            dense_note = (f"dense grads all-reduce over expert "
+                          f"({self.ep_size}) + reduce-scatter over data "
+                          f"({self.dp_size})")
+        else:
+            dense_wire = ring("all-reduce", model["grad_bytes"],
+                              self.replica_size)
+            dense_note = (f"dense grads all-reduce over expert x data "
+                          f"({self.replica_size})")
+        expert_wire = ring("all-reduce", expert_local, self.dp_size)
+        a2a = int(model.get("moe_alltoall_wire_bytes") or 0)
+        # The honest dense-baseline comparator the init log prints: one
+        # all-reduce of EVERYTHING (expert grads replicated across
+        # experts — the failure mode) over the full replica set.
+        model["all_reduce_wire_bytes"] = ring(
+            "all-reduce", model["grad_bytes"] + expert_full,
+            self.replica_size)
+        model.update(expert_grad_bytes_local=int(expert_local),
+                     expert_grad_wire_bytes=int(expert_wire),
+                     dense_grad_wire_bytes=int(dense_wire))
+        self._wire_model = model
+        per_tok = model["moe"]["wire_bytes_per_token"]
+        detail = (
+            f"{self._grad_sync_mode} MoE ep={self.ep_size}: {dense_note}; "
+            f"expert grads ({expert_local:,} B/device) all-reduce over "
+            f"data within their expert group only; dispatch/combine "
+            f"all-to-all {per_tok:,} B/token"
+            + (f" = {a2a:,} B/step" if a2a
+               else " (per-step figure resolves at the first batch)"))
+        return int(dense_wire + expert_wire + a2a), detail
+
+    def _maybe_refresh_moe_wire(self, micro_batches) -> None:
+        """Resolve the MoE all-to-all wire term exactly once the token
+        count is visible (first batch): tokens/device/micro-step = the
+        per-device sample count x tokens-per-sample (LM token batches
+        [gas, B, S+1] route S tokens; other shapes use the trailing-dim
+        product). Updates the analytic wire bytes + telemetry meta —
+        host metadata only, no device access."""
+        if self._moe is None or self.ep_size <= 1 or \
+                self._moe_tokens_per_device is not None:
+            return
+        leaves = [l for l in jax.tree_util.tree_leaves(micro_batches)
+                  if hasattr(l, "shape") and getattr(l, "ndim", 0) >= 2]
+        if not leaves:
+            return
+        leaf = leaves[0]
+        per_dev = max(1, int(leaf.shape[1]) // max(1, self.replica_size))
+        if len(leaves) == 1 and leaf.ndim == 3 and \
+                jnp.issubdtype(leaf.dtype, jnp.integer):
+            # The combined LM layout [gas, B, S+1] (inputs [:, :-1]):
+            # S tokens route. A (tokens, targets) PAIR has two leaves
+            # and routes all S — the generic branch below.
+            per_sample = max(1, int(leaf.shape[2]) - 1)
+        else:
+            per_sample = int(np.prod(leaf.shape[2:])) or 1
+        self._moe_tokens_per_device = per_dev * per_sample
+        self._wire_bytes, self._wire_detail = self._grad_wire_bytes()
+        tl = self.telemetry
+        if tl.enabled:
+            tl.meta["wire_bytes_per_step"] = self._wire_bytes
+            tl.meta["wire_detail"] = self._wire_detail
+            if isinstance(self._wire_model, dict) and \
+                    "moe" in self._wire_model:
+                tl.meta["moe_alltoall_wire_bytes_per_step"] = \
+                    int(self._wire_model["moe_alltoall_wire_bytes"])
+
     def _log_comm_plan(self) -> None:
         """Init-time communication honesty (audited lowering + analytic
         wire bytes/step) — the knobs act or report, never silently."""
@@ -897,6 +1088,11 @@ class DeepSpeedEngine:
                 "are overlapped by XLA's latency-hiding scheduler "
                 "automatically; the knob only selects the bucketed host "
                 "pipeline under cpu_offload", ranks=[0])
+        if self.ep_size > 1:
+            log_dist(f"MoE expert parallelism: {self._wire_detail}; "
+                     f"~{self._wire_bytes:,} wire bytes/step "
+                     f"(ep={self.ep_size} x dp={self.dp_size})", ranks=[0])
+            return
         if self.zero_optimization_stage() < 2 or self.dp_size <= 1:
             return
         log_dist(
@@ -1023,7 +1219,8 @@ class DeepSpeedEngine:
                            cast_params=(params_sh if self._use_cast_cache
                                         else None))
 
-    def _metrics_shardings(self, with_taps: bool = False
+    def _metrics_shardings(self, with_taps: bool = False,
+                           with_moe: bool = False
                            ) -> Dict[str, NamedSharding]:
         """Replicated shardings for the step-metrics dict. Declared (with
         ``_state_shardings``) as out_shardings on every DONATING step
@@ -1040,6 +1237,12 @@ class DeepSpeedEngine:
                                    "loss_scale", "overflow")}
         if with_taps:
             out["health_leaf_sq"] = scalar
+        if with_moe:
+            # [num_experts] routed counts + scalar drop/aux/z, all
+            # replicated — drain material, no hot-path syncs.
+            for k in ("moe_expert_tokens", "moe_drop_fraction",
+                      "moe_aux_loss", "moe_z_loss"):
+                out[k] = scalar
         return out
 
     def _place_state(self, state: EngineState) -> EngineState:
@@ -1062,9 +1265,13 @@ class DeepSpeedEngine:
         return jax.jit(place, out_shardings=self._state_shardings)(state)
 
     def _batch_sharding(self, batch_tree, leading_dims: int = 1):
-        """Shard batch arrays over dp on the (micro-)batch axis."""
+        """Shard batch arrays over the replica axes on the (micro-)batch
+        dim — (expert, data) jointly when expert parallelism is live
+        (expert factors out of data), plain dp otherwise."""
+        batch_axes = (EP_AXIS, DP_AXIS) if self.ep_size > 1 else DP_AXIS
+
         def spec(x):
-            pspec = P(*([None] * (leading_dims - 1) + [DP_AXIS]))
+            pspec = P(*([None] * (leading_dims - 1) + [batch_axes]))
             return NamedSharding(self.mesh, pspec)
         return jax.tree_util.tree_map(spec, batch_tree)
 
@@ -1211,8 +1418,8 @@ class DeepSpeedEngine:
                 theta = pld.theta_at(step.astype(jnp.float32)) \
                     if accepts_pld else None
                 keys = jax.random.split(rng, gas)
-                grads, mean_loss = explicit(params, micro_batches, keys,
-                                            scale, theta)
+                grads, mean_loss, _aux = explicit(params, micro_batches,
+                                                  keys, scale, theta)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(wire_dtype), grads)
                 return regroup(grads), mean_loss
@@ -1230,8 +1437,8 @@ class DeepSpeedEngine:
                 # add pass AND the fp32-sized transient (for the 1.5B
                 # bench config that transient alone is 6 GB of HBM).
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                (_, raw_loss), grads = grad_fn(params, mb, keys[0], scale,
-                                               theta)
+                (_, (raw_loss, _aux)), grads = grad_fn(params, mb, keys[0],
+                                                       scale, theta)
                 grads = constrain_grads(grads)
                 return (regroup(jax.tree_util.tree_map(
                     lambda g: g.astype(wire_dtype), grads)),
@@ -1240,7 +1447,8 @@ class DeepSpeedEngine:
             def accum(carry, xs):
                 g_acc, loss_acc = carry
                 mb, key = xs
-                (_, raw_loss), grads = grad_fn(params, mb, key, scale, theta)
+                (_, (raw_loss, _aux)), grads = grad_fn(params, mb, key,
+                                                       scale, theta)
                 g_acc = constrain_grads(
                     jax.tree_util.tree_map(jnp.add, g_acc, grads))
                 return (g_acc, loss_acc + raw_loss.astype(jnp.float32) / gas), None
@@ -1897,6 +2105,19 @@ class DeepSpeedEngine:
                     lambda x: x.astype(jnp.float32), g)
             return jax.tree_util.tree_map(scatter_leaf, g, dims_tree)
 
+        def reduce_aux(aux):
+            # Aux stats are computed on each rank's LOCAL tokens here
+            # (the MoE layer runs its ep==1 path inside this shard_map —
+            # ep > 1 never resolves to the explicit mode): counts sum
+            # over dp, the rest mean.
+            if not isinstance(aux, dict) or "moe" not in aux:
+                return aux
+            moe = dict(aux["moe"])
+            for k, v in moe.items():
+                moe[k] = lax.psum(v, DP_AXIS) if k == "expert_tokens" \
+                    else lax.pmean(v, DP_AXIS)
+            return {**aux, "moe": moe}
+
         def per_rank(params, micro_batches, keys, scale, theta):
             rank = lax.axis_index(DP_AXIS)
             keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
@@ -1915,23 +2136,23 @@ class DeepSpeedEngine:
                     jnp.issubdtype(x.dtype, jnp.floating) else x, params)
             if gas == 1:
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                (_, raw_loss), g = grad_fn(params, mb, keys[0], scale,
-                                           theta_arg)
+                (_, (raw_loss, aux)), g = grad_fn(params, mb, keys[0],
+                                                  scale, theta_arg)
                 g = reduce_grads(g)
                 loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
                     g_acc, loss_acc = carry
                     mb, key = xs
-                    (_, raw_loss), g = grad_fn(params, mb, key, scale,
-                                               theta_arg)
+                    (_, (raw_loss, aux)), g = grad_fn(params, mb, key,
+                                                      scale, theta_arg)
                     # Scatter per micro-step and carry only the 1/dp
                     # shards: the accumulation buffer never holds an
                     # unpartitioned gradient (the stage-2 invariant).
                     g_acc = jax.tree_util.tree_map(
                         jnp.add, g_acc, reduce_grads(g))
                     return (g_acc, loss_acc +
-                            raw_loss.astype(jnp.float32) / gas), None
+                            raw_loss.astype(jnp.float32) / gas), aux
 
                 def zero_shard(p, d):
                     shape = list(p.shape)
@@ -1942,15 +2163,19 @@ class DeepSpeedEngine:
 
                 zeros = jax.tree_util.tree_map(zero_shard, params,
                                                dims_tree)
-                (g, loss), _ = lax.scan(
+                (g, loss), aux_stack = lax.scan(
                     accum, (zeros, jnp.asarray(0.0, jnp.float32)),
                     (micro_batches, keys))
+                # Aux rides as stacked scan outputs; report the
+                # micro-step mean (None stays None).
+                aux = jax.tree_util.tree_map(
+                    lambda a: jnp.mean(a, axis=0), aux_stack)
             # loss_fn normalizes over its LOCAL shard, so the summed grads
             # and losses are dp x the global-mean values; /dp is exact for
             # power-of-two dp (bit-parity with the declarative path).
             g = jax.tree_util.tree_map(lambda x: x / dp, g)
             loss = lax.psum(loss, DP_AXIS) / dp
-            return g, loss
+            return g, loss, reduce_aux(aux)
 
         def explicit_grads(params, micro_batches, keys, scale, theta):
             batch_specs = jax.tree_util.tree_map(
@@ -1960,7 +2185,7 @@ class DeepSpeedEngine:
             fn = shard_map(per_rank, mesh=mesh,
                            in_specs=(param_in_specs, batch_specs, P(),
                                      P(), P()),
-                           out_specs=(grad_out_specs, P()),
+                           out_specs=(grad_out_specs, P(), P()),
                            check_vma=False)
             return fn(params, micro_batches, keys, scale, theta_in)
 
@@ -1975,7 +2200,7 @@ class DeepSpeedEngine:
         gas = self._scan_microbatches()
         # Single-chip/single-process: the step consumes the user's flat
         # batch directly and splits micro-batches device-side.
-        flat_batch = self.dp_size == 1 and jax.process_count() == 1
+        flat_batch = self.replica_size == 1 and jax.process_count() == 1
         clip = self.gradient_clipping()
         fp16 = self.config.fp16_enabled
         schedule_fn = self._schedule_fn
@@ -2013,6 +2238,7 @@ class DeepSpeedEngine:
         use_cache = self._use_cast_cache
         master_free = self._master_free
         health_taps = self._health_tap_fn
+        moe_cfg = self._moe
 
         raw_scaled_loss = _make_raw_scaled_loss(loss_fn, accepts_pld,
                                                 gas)
@@ -2068,11 +2294,12 @@ class DeepSpeedEngine:
                     scale)
                 grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = mean_loss.astype(jnp.float32)
+                aux = None
             elif explicit_grads_fn is not None:
                 # Guaranteed reduce-scatter: grads leave the shard_map
                 # already dp-sharded and f32 (no constraint needed — the
                 # out_specs ARE the ZeRO-2 layout).
-                grads, mean_loss = explicit_grads_fn(
+                grads, mean_loss, aux = explicit_grads_fn(
                     loss_params, micro_batches, keys, scale, theta)
             elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
@@ -2083,27 +2310,30 @@ class DeepSpeedEngine:
                 # construction); XLA folds the widening cast into the
                 # consumer, so no extra materialized pass.
                 mb = jax.tree_util.tree_map(lambda x: x[0], micro_batches)
-                (_, raw_loss), grads = grad_fn(loss_params, mb, keys[0],
-                                               scale, theta)
+                (_, (raw_loss, aux)), grads = grad_fn(
+                    loss_params, mb, keys[0], scale, theta)
                 grads = constrain_grads(_cast_floats(grads, jnp.float32))
                 mean_loss = raw_loss.astype(jnp.float32)
             else:
                 def accum(carry, xs):
                     g_acc, loss_acc = carry
                     mb, key = xs
-                    (_, raw_loss), grads = grad_fn(loss_params, mb, key,
-                                                   scale, theta)
+                    (_, (raw_loss, aux)), grads = grad_fn(loss_params, mb,
+                                                          key, scale, theta)
                     g_acc = constrain_grads(
                         jax.tree_util.tree_map(jnp.add, g_acc, grads))
                     return (g_acc,
-                            loss_acc + raw_loss.astype(jnp.float32) / gas), None
+                            loss_acc + raw_loss.astype(jnp.float32) / gas), \
+                        aux
 
                 zero_grads = constrain_grads(jax.tree_util.tree_map(
                     lambda p: jnp.zeros(p.shape, jnp.float32)
                     if hasattr(p, "dtype") else p, state.params))
-                (grads, mean_loss), _ = lax.scan(
+                (grads, mean_loss), aux_stack = lax.scan(
                     accum, (zero_grads, jnp.asarray(0.0, jnp.float32)),
                     (micro_batches, keys))
+                aux = jax.tree_util.tree_map(
+                    lambda a: jnp.mean(a, axis=0), aux_stack)
 
             # Health tap BEFORE the apply consumes the grads: one small
             # stacked array of per-leaf sum-of-squares (non-finite entry
@@ -2193,12 +2423,29 @@ class DeepSpeedEngine:
             }
             if tap is not None:
                 metrics["health_leaf_sq"] = tap
+            if moe_cfg is not None:
+                # The moe block promises MoE metrics (the out_shardings
+                # schema is fixed pre-trace); a dense model behind it is
+                # a config error, said plainly.
+                if not (isinstance(aux, dict) and "moe" in aux):
+                    raise ValueError(
+                        "ds_config has a `moe` block but the model's "
+                        "loss_fn returned no moe stats — build the model "
+                        "with TransformerConfig.moe "
+                        "(deepspeed_tpu.moe.MoEConfig) or drop the block")
+                st = aux["moe"]
+                metrics["moe_expert_tokens"] = \
+                    st["expert_tokens"].astype(jnp.float32)
+                metrics["moe_drop_fraction"] = st["drop_fraction"]
+                metrics["moe_aux_loss"] = st["aux_loss"]
+                metrics["moe_z_loss"] = st["z_loss"]
             return new_state, metrics
 
         return jax.jit(train_step, donate_argnums=(0,),
                        out_shardings=(self._state_shardings,
                                       self._metrics_shardings(
-                                          with_taps=health_taps is not None)))
+                                          with_taps=health_taps is not None,
+                                          with_moe=moe_cfg is not None)))
 
     def _build_eval_step(self):
         loss_fn = self.loss_fn
@@ -2288,7 +2535,7 @@ class DeepSpeedEngine:
                 tl.ledger.note("data_stall",
                                time.perf_counter() - t_fetch0)
 
-        if self._offload is None and self.dp_size == 1 \
+        if self._offload is None and self.replica_size == 1 \
                 and jax.process_count() == 1:
             # Flat fast path: no host-side tree ops at all; the jitted step
             # does the micro-batch split on device.
@@ -2296,7 +2543,7 @@ class DeepSpeedEngine:
             micro_batches = batch
         else:
             micro_batches = self._stack_micro_batches(batch)
-        if self.dp_size > 1:
+        if self.replica_size > 1:
             # Shard the per-micro-step batch dim over dp so XLA partitions
             # the whole forward/backward data-parallel. Multi-process: each
             # process holds only its local dp share, so assemble the global
@@ -2316,6 +2563,7 @@ class DeepSpeedEngine:
         if tl.tracer is not None:
             tl.add_span("data_prep", t_wall0,
                         time.perf_counter() - t_wall0)
+        self._maybe_refresh_moe_wire(micro_batches)
 
         self.tput_timer.start()
         t_dispatch = time.perf_counter()
@@ -2562,6 +2810,58 @@ class DeepSpeedEngine:
                 prefetch_depth=self._prefetch_depth,
                 scan_paths=spec.covers if spec is not None else None,
                 mesh=self.mesh)
+        # Expert-sharded leaves (MoE, ep > 1): the payload sizes an
+        # expert-grad collective may legally carry (the per-device 1/ep
+        # shard, and its per-layer slice inside the block scan) — any
+        # all-reduce of one with replica groups WIDER than the data axis
+        # spans the expert axis, i.e. treats experts as replicas: the
+        # seeded-violation case collective_placement catches.
+        expert_bytes: set = set()
+        if self.ep_size > 1 and self._param_specs is not None:
+            from ..moe.sharding import is_expert_spec
+            all_leaves = jax.tree_util.tree_leaves(self.state.params)
+            spec_leaves = jax.tree_util.tree_structure(
+                self.state.params).flatten_up_to(self._param_specs)
+            itemsizes = (4, int(jnp.dtype(self.compute_dtype).itemsize))
+            from jax.sharding import PartitionSpec as _P
+
+            def payloads(nelems, ndim, lead):
+                # Full local buffer + its per-layer slice inside the
+                # block scan, at f32 and the wire dtype.
+                out = set()
+                for b in itemsizes:
+                    out.add(nelems * b)
+                    if ndim >= 3 and lead > 0:
+                        out.add(nelems // lead * b)
+                return out
+
+            dense_payloads: set = set()
+            for l, sp in zip(all_leaves, spec_leaves):
+                if not hasattr(l, "shape") or \
+                        (isinstance(sp, _P) and is_expert_spec(sp)):
+                    continue
+                dense_payloads |= payloads(
+                    int(l.size), getattr(l, "ndim", 0),
+                    int(l.shape[0]) if getattr(l, "ndim", 0) else 0)
+            for l, sp in zip(all_leaves, spec_leaves):
+                if not hasattr(l, "shape") or \
+                        not (isinstance(sp, _P) and is_expert_spec(sp)):
+                    continue
+                for payload in payloads(
+                        int(l.size) // self.ep_size,
+                        getattr(l, "ndim", 0),
+                        int(l.shape[0]) if getattr(l, "ndim", 0) else 0):
+                    # The check is a payload-size heuristic, so two
+                    # guards against false positives: a 64 KiB floor
+                    # (bias-sized expert leaves are byte-identical to
+                    # small dense grads — a [H, E] router grad matches
+                    # an expert-bias slice) and exclusion of any size a
+                    # DENSE leaf could legally all-reduce at across the
+                    # full replica set. A colliding size loses coverage
+                    # for that one leaf, never CI.
+                    if payload >= 64 * 1024 and \
+                            payload not in dense_payloads:
+                        expert_bytes.add(payload)
         return {
             "grad_sync_path": name in grad_paths,
             "grad_sync_mode": getattr(self, "_grad_sync_mode", "none"),
@@ -2573,6 +2873,9 @@ class DeepSpeedEngine:
             "param_bytes_full": int(param_bytes_full),
             "largest_leaf_bytes": int(largest_leaf),
             "dp": self.dp_size,
+            "ep": self.ep_size,
+            "expert_leaf_bytes": sorted(expert_bytes),
+            "expert_group_size": self.dp_size,
             "zero_stage": self.zero_optimization_stage(),
             "zero3": bool(self._zero3),
             "zero3_gather_bytes": int(gather_ws),
@@ -2772,10 +3075,15 @@ class DeepSpeedEngine:
         def grad_step(params, mb, key, scale, theta=None):
             if explicit_fn is not None:
                 # One micro-batch per trio call: wrap in the [gas=1]
-                # leading axis the explicit path scans over.
+                # leading axis the explicit path scans over. The trio
+                # has no metrics dict for MoE stats to ride — aux drops
+                # (the aux LOSS is already inside raw_loss).
                 mb1 = jax.tree_util.tree_map(lambda x: x[None], mb)
-                return explicit_fn(params, mb1, key[None], scale, theta)
-            (_, raw_loss), grads = vg(params, mb, key, scale, theta)
+                g, loss, _aux = explicit_fn(params, mb1, key[None],
+                                            scale, theta)
+                return g, loss
+            (_, (raw_loss, _aux)), grads = vg(params, mb, key, scale,
+                                              theta)
             # fp32 grads regardless of compute dtype: backward() accumulates
             # micro-batches in these, and apply_grads clips/updates in fp32.
             return _cast_floats(grads, jnp.float32), raw_loss

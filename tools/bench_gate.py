@@ -41,7 +41,11 @@ section is additionally validated on the NEW side alone: UNSKIPPED
 non-finite anomalies (overflow-skipped steps are routine fp16
 loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
 died without its final drain marker) fail the round — those are not
-regressions to diff but defects to refuse. A metric missing on either
+regressions to diff but defects to refuse. MoE rounds (a ``moe``
+section in TELEMETRY.json, or MOE_BENCH.json) gate the drop-fraction
+p95 on an ABSOLUTE rise beyond ``--moe-drop-rise`` (default 0.05) —
+dropped tokens are silently-skipped compute; pre-MoE rounds skip,
+never fail. A metric missing on either
 side is skipped with a notice, never a failure — rounds recorded before
 this tool (or before the serving tier / health layer) existed have no
 such field, and the gate must not retroactively break them. Exit 0 =
@@ -120,6 +124,18 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         if isinstance(spec, dict) and \
                 spec.get("acceptance_rate") is not None:
             accept_rate = float(spec["acceptance_rate"])
+    # MoE shape: a TELEMETRY.json `moe` section or an MOE_BENCH.json
+    # record — the gated figure is the drop-fraction p95 (regression =
+    # an ABSOLUTE rise: dropped tokens are silently-skipped compute).
+    # Pre-MoE rounds carry no section -> skipped, never failed.
+    moe_drop: Optional[float] = None
+    msec = doc.get("moe")
+    if isinstance(msec, dict) and msec.get("available", True):
+        df = msec.get("drop_fraction")
+        if isinstance(df, dict) and df.get("p95") is not None:
+            moe_drop = float(df["p95"])
+        elif isinstance(df, (int, float)):
+            moe_drop = float(df)
     # Health-layer TELEMETRY.json shape: validated (new side only), not
     # diffed. Pre-health rounds carry no section -> None -> skipped.
     health: Optional[Dict[str, Any]] = None
@@ -139,7 +155,8 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
-            "hbm_per_token": hbm_per_token, "accept_rate": accept_rate}
+            "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
+            "moe_drop": moe_drop}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -163,7 +180,8 @@ def latest_rounds(directory: str) -> Optional[Tuple[str, str]]:
 def gate(old_path: str, new_path: str, mfu_drop: float,
          goodput_drop: float, serve_drop: float = 0.10,
          ttft_rise: float = 0.25, kernel_drop: float = 0.10,
-         hbm_rise: float = 0.15, accept_floor: float = 0.05) -> int:
+         hbm_rise: float = 0.15, accept_floor: float = 0.05,
+         moe_drop_rise: float = 0.05) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -309,6 +327,22 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"zero3 prefetch overlap: skipped (no zero3 record in "
               f"{', '.join(missing)})")
 
+    if old["moe_drop"] is not None and new["moe_drop"] is not None:
+        compared += 1
+        ceil = old["moe_drop"] + moe_drop_rise
+        verdict = "OK" if new["moe_drop"] <= ceil else "REGRESSION"
+        print(f"moe drop fraction p95: {name_old}={old['moe_drop']:.4f} "
+              f"-> {name_new}={new['moe_drop']:.4f} "
+              f"(ceiling {ceil:.4f}, +{moe_drop_rise:.2f} abs): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-MoE rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["moe_drop"] is None]
+        print(f"moe drop fraction: skipped (no moe record in "
+              f"{', '.join(missing)})")
+
     # Health validation: NEW side only (defects, not diffs). Pre-health
     # rounds skip, never fail.
     nh = new.get("health")
@@ -366,6 +400,9 @@ def main(argv=None) -> int:
     ap.add_argument("--accept-floor", type=float, default=0.05,
                     help="spec-decode acceptance-rate floor on the new "
                          "side (default 0.05)")
+    ap.add_argument("--moe-drop-rise", type=float, default=0.05,
+                    help="max tolerated ABSOLUTE rise of the MoE "
+                         "drop-fraction p95 (default 0.05)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -382,7 +419,7 @@ def main(argv=None) -> int:
     try:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
                     args.serve_drop, args.ttft_rise, args.kernel_drop,
-                    args.hbm_rise, args.accept_floor)
+                    args.hbm_rise, args.accept_floor, args.moe_drop_rise)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

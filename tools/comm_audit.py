@@ -27,6 +27,12 @@ of the repo's central scaling claims:
   boundary, ticks = M + 2(P-1).
 - **ring_attention**: K/V chunks rotate by collective-permute — bytes =
   2 · sp · chunk per forward.
+- **moe**: expert-parallel MoE FFN — dispatch/combine lower to REAL
+  all-to-alls over the `expert` axis (the first non-synthetic producer
+  of the family this parser has priced since PR 6), 4 per MoE layer per
+  step (fwd pair + backward transposes), each (ep-1)/ep of the [E,C,H]
+  dispatch buffer; expert grads all-reduce within their expert group
+  (data) only.
 
 Usage: python tools/comm_audit.py [--out COMM_AUDIT.json]
 (tools/run_comm_audit.sh wraps this with the tier-1 env.)
@@ -420,6 +426,106 @@ def audit_ring_attention():
     }
 
 
+def audit_moe():
+    """MoE expert parallelism: the FIRST real producer of the
+    all-to-all family this module's parser has priced synthetically
+    since PR 6. An 8-expert top-2 gpt2-tiny on the ep=4 x dp=2 mesh
+    (ZeRO-1, unrolled layers so every collective appears literally):
+
+    - dispatch + combine lower to REAL all-to-alls over the 4-member
+      expert groups — 4 per MoE layer (fwd pair + their backward
+      transposes), each moving exactly the [E, C, H] dispatch buffer;
+    - compiled all-to-all wire within 5% of the analytic
+      ``moe_alltoall_wire_model`` (exact, in fact: the buffer shape is
+      static);
+    - expert-weight grads all-reduce over ``data`` WITHIN their expert
+      group only (groups never wider than dp) — experts are not
+      replicas;
+    - no collective gathers token buffers ACROSS expert groups (the
+      all-to-all degenerating to all-gather; gathers over data are the
+      legal ZeRO-1 param pattern)."""
+    import dataclasses
+    from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,
+                                           gpt2_loss_fn)
+    from deepspeed_tpu.moe import MoEConfig, gpt2_moe_param_shardings
+
+    ep, E, k, cf = 4, 8, 2, 1.5
+    mesh = build_mesh(ep=ep)
+    moe = MoEConfig(num_experts=E, top_k=k, capacity_factor=cf,
+                    expert_parallel_size=ep)
+    cfg = dataclasses.replace(
+        GPT2_CONFIGS["gpt2-tiny"], vocab_size=64, max_seq_length=33,
+        hidden_dropout=0.0, attn_dropout=0.0, dtype=jnp.float32,
+        fused_kernels=False, scan_layers=False, moe=moe)
+    e, *_ = deepspeed_tpu.initialize(
+        model=gpt2_loss_fn(cfg, mesh=mesh),
+        model_params=gpt2_init(jax.random.PRNGKey(0), cfg),
+        config={"train_batch_size": 32,
+                "train_micro_batch_size_per_gpu": 4,
+                "gradient_accumulation_steps": 1,
+                "zero_optimization": {"stage": 1},
+                "optimizer": {"type": "Adam",
+                              "params": {"lr": 1e-3, "fused": False}},
+                "moe": {"num_experts": E, "top_k": k,
+                        "capacity_factor": cf,
+                        "expert_parallel_size": ep},
+                "steps_per_print": 10 ** 9},
+        mesh=mesh, param_shardings=gpt2_moe_param_shardings(cfg))
+    batch = np.random.default_rng(0).integers(
+        0, 64, size=(32, 34)).astype(np.int32)
+    mb = e._stack_micro_batches(batch)
+    mb = jax.device_put(mb, e._batch_sharding(mb, leading_dims=2))
+    audit = hlo_audit.audit_jit(e._build_train_step(), e.state, mb,
+                                e._base_rng)
+    n_moe = cfg.num_layers
+    tokens_per_device = (32 // e.replica_size) * 33
+    model = hlo_audit.moe_alltoall_wire_model(
+        hidden=cfg.hidden_size, num_experts=E, top_k=k,
+        capacity_factor=cf, ep=ep, n_moe_layers=n_moe, bytes_per_el=4,
+        tokens_per_device=tokens_per_device)
+    a2a = audit.of_kind("all-to-all")
+    compiled_wire = sum(o.wire_bytes for o in a2a)
+    meta = e._lint_path_meta("train_step")
+    expert_bytes = set(meta["expert_leaf_bytes"])
+    cross_expert_ar = [o for o in audit.of_kind("all-reduce")
+                       if o.payload_bytes in expert_bytes
+                       and o.group_size > e.dp_size]
+    expert_gather = [o for o in audit.of_kind("all-gather")
+                     if o.group_size > e.dp_size
+                     and o.payload_bytes >= model["dispatch_buffer_bytes"]]
+    checks = {
+        "alltoall_pair_per_moe_layer": len(a2a) >= 2 * n_moe,
+        "fwd_plus_bwd_alltoalls": len(a2a) == 4 * n_moe,
+        "alltoall_payload_is_dispatch_buffer": bool(a2a) and all(
+            o.payload_bytes == model["dispatch_buffer_bytes"]
+            for o in a2a),
+        "alltoall_groups_are_expert_axis": bool(a2a) and all(
+            o.group_size == ep for o in a2a),
+        "wire_within_5pct_of_model": bool(a2a) and abs(
+            compiled_wire - model["wire_bytes_per_step"]) <= \
+            0.05 * model["wire_bytes_per_step"],
+        "no_expert_grad_allreduce_across_experts": not cross_expert_ar,
+        "no_cross_group_token_gather": not expert_gather,
+    }
+    return {
+        "config": {"num_experts": E, "top_k": k, "capacity_factor": cf,
+                   "ep": ep, "dp": e.dp_size,
+                   "moe_layers": n_moe,
+                   "tokens_per_device": tokens_per_device,
+                   "zero_stage": 1},
+        "hlo": audit.summary(),
+        "model": model,
+        "compiled_alltoall_wire_bytes": compiled_wire,
+        "compiled_alltoalls": len(a2a),
+        "expert_grad_allreduces": [
+            {"payload_bytes": o.payload_bytes, "group_size": o.group_size,
+             "num_groups": o.num_groups}
+            for o in audit.of_kind("all-reduce")
+            if o.payload_bytes in expert_bytes],
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
 def audit_fused_chunk_finding():
     """Regression guard for a RESOLVED finding: the fused optimizer's
     chunked multi-tensor front end used to concatenate dp-sharded leaves
@@ -467,7 +573,8 @@ def main():
                      ("zero3", audit_zero3),
                      ("onebit", audit_onebit),
                      ("pipeline_1f1b", audit_1f1b),
-                     ("ring_attention", audit_ring_attention)]:
+                     ("ring_attention", audit_ring_attention),
+                     ("moe", audit_moe)]:
         print(f"[comm_audit] auditing {name} ...", flush=True)
         try:
             record["configs"][name] = fn()

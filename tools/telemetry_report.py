@@ -33,6 +33,11 @@ so benches and CI can compare runs:
   over decode iterations, TTFT/TPOT p50/p95 from ``request_complete``
   events, tokens/s and decode-step percentiles from the last report's
   aggregator snapshot.
+- ``moe``: present when the run carried MoE metrics (the engine's
+  ``moe`` config block): drop-fraction p50/p95/last, expert-load
+  imbalance (max/mean routed counts — 1.0 is balanced), last aux loss,
+  and the analytic all-to-all wire bytes/step from the meta record.
+  ``tools/bench_gate.py`` gates drop-fraction rises across rounds.
 - ``health``: anomaly counts (non-finite provenance events, EWMA
   spikes), watchdog fires, flight-recorder presence (FLIGHT.json next
   to the stream, with its recorded reason), the ``truncated`` verdict,
@@ -246,6 +251,44 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         if "skipped_steps" in rep:
             skipped = int(rep["skipped_steps"])
             break
+
+    # MoE section: per-step expert load-balance stats from the moe_*
+    # metrics the engine rides on the drain (meta `moe` block = the
+    # config truth). Imbalance = max/mean of the per-expert routed
+    # token counts — 1.0 is perfectly balanced; bench_gate diffs the
+    # drop-fraction percentiles across rounds.
+    moe: Dict[str, Any] = {"available": False}
+    moe_steps = [r for r in steps if "moe_drop_fraction" in r]
+    if moe_steps:
+        drops = sorted(float(r["moe_drop_fraction"]) for r in moe_steps)
+        aux = [float(r["moe_aux_loss"]) for r in moe_steps
+               if "moe_aux_loss" in r]
+        imbalance = []
+        for r in moe_steps:
+            counts = r.get("moe_expert_tokens")
+            if isinstance(counts, list) and counts:
+                mean = sum(counts) / len(counts)
+                if mean > 0:
+                    imbalance.append(max(counts) / mean)
+        moe = {
+            "available": True,
+            "config": meta.get("moe") or {},
+            "ep": meta.get("ep"),
+            "steps": len(moe_steps),
+            "drop_fraction": {
+                "p50": round(_percentile(drops, 50), 5),
+                "p95": round(_percentile(drops, 95), 5),
+                "last": round(drops and float(
+                    moe_steps[-1]["moe_drop_fraction"]) or 0.0, 5),
+            },
+            "aux_loss_last": round(aux[-1], 5) if aux else None,
+            "expert_imbalance": {
+                "p50": round(_percentile(sorted(imbalance), 50), 4),
+                "max": round(max(imbalance), 4) if imbalance else None,
+            } if imbalance else {"p50": None, "max": None},
+            "alltoall_wire_bytes_per_step":
+                meta.get("moe_alltoall_wire_bytes_per_step"),
+        }
 
     # MFU: per-step figures are dispatch-wall based (honest but loose on
     # jitted paths); window_mfu comes from the fenced throughput window.
@@ -529,6 +572,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "roofline": roofline,
         "goodput": goodput,
         "serving": serving,
+        "moe": moe,
         "health": health,
         "truncated": truncated,
     }
