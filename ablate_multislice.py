@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""Multi-slice gradient-sync ablation: flat vs hierarchical vs
+hierarchical + 1-bit DCN compression, on the two-tier analytic model.
+
+Projects per-step gradient-sync communication for a production-shaped
+config (gpt2-large on 2 x 64-chip v5e slices by default) under the
+three schedules:
+
+- **flat**: one joint collective over (slice, data) — every link,
+  including the DCN boundary links, carries ~grad-sized traffic;
+- **hierarchical**: in-slice reduce-scatter over ICI, inter-slice
+  all-reduce of the 1/dp residual over DCN (parallel/multislice.py) —
+  the DCN traffic divides by dp;
+- **hierarchical + 1-bit DCN**: the same schedule with the inter-slice
+  hop in the packed sign-bit wire format
+  (``zero_optimization.dcn_compression``) — ~32x fewer DCN bytes again.
+
+Times are PROJECTIONS from the analytic wire model and the shared chip
+peak table (monitor/peaks.py) — per-chip ICI vs DCN bandwidth — NOT
+measurements: this box has no TPU and no DCN, and the CPU "slices" the
+tests run on are virtual mesh axes in one host's memory. What the
+projection is for is the STRUCTURAL claim (how many bytes cross the
+slow tier per step under each schedule), which tools/comm_audit.py pins
+against the compiled programs, and the MULTISLICE_BENCH.json record
+tools/bench_gate.py gates DCN-byte rises with.
+
+Usage: python ablate_multislice.py [--record] [--slices 2] [--dp 64]
+                                   [--model gpt2-large]
+"""
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init  # noqa: E402
+from deepspeed_tpu.monitor.peaks import peaks_for_kind  # noqa: E402
+from deepspeed_tpu.parallel import hlo_audit  # noqa: E402
+from deepspeed_tpu.parallel.multislice import (  # noqa: E402
+    dcn_compression_ratio)
+
+
+def project(model_name: str, slices: int, dp: int, chip: str = "v5e"):
+    cfg = GPT2_CONFIGS[model_name]
+    # Shapes only — eval_shape traces init without touching a device.
+    shapes = jax.eval_shape(
+        lambda k: gpt2_init(k, cfg), jax.random.PRNGKey(0))
+    n_el = sum(int(jnp.prod(jnp.asarray(l.shape)))
+               for l in jax.tree_util.tree_leaves(shapes))
+    model = hlo_audit.grad_sync_wire_model(shapes, dp, slices=slices)
+    peaks = peaks_for_kind(chip)
+
+    def ms(nbytes: float, bw_bytes_per_s: float) -> float:
+        return nbytes / bw_bytes_per_s * 1e3
+
+    flat_dcn = model["flat_dcn_link_bytes"]
+    rows = {
+        "flat": {
+            "ici_bytes_per_step": model["reduce_scatter_wire_bytes"],
+            "dcn_bytes_per_step": int(flat_dcn),
+            "note": "joint (slice, data) ring: ~grad-sized traffic on "
+                    "every link incl. the DCN boundary links",
+        },
+        "hierarchical": {
+            "ici_bytes_per_step": model["ici_wire_bytes"],
+            "dcn_bytes_per_step": model["dcn_wire_bytes"],
+            "note": "in-slice reduce-scatter + inter-slice all-reduce "
+                    "of the 1/dp residual",
+        },
+        "hierarchical_1bit_dcn": {
+            "ici_bytes_per_step": model["ici_wire_bytes"],
+            "dcn_bytes_per_step": model["dcn_wire_bytes_compressed"],
+            "note": "same schedule; DCN hop in the packed sign-bit "
+                    "wire format (zero_optimization.dcn_compression)",
+        },
+    }
+    for row in rows.values():
+        t_ici = ms(row["ici_bytes_per_step"], peaks.ici_bytes_per_sec)
+        t_dcn = ms(row["dcn_bytes_per_step"], peaks.dcn_bytes_per_sec)
+        row.update(projected_t_ici_ms=round(t_ici, 4),
+                   projected_t_dcn_ms=round(t_dcn, 4),
+                   projected_comm_floor_ms=round(max(t_ici, t_dcn), 4),
+                   comm_bound_tier="dcn" if t_dcn > t_ici else "ici")
+    return {
+        "model": model_name,
+        "param_elements": int(n_el),
+        "slices": slices,
+        "dp_per_slice": dp,
+        "chip": peaks.as_dict(),
+        "wire_model": {k: v for k, v in model.items() if k != "moe"},
+        "schedules": rows,
+        "dcn_compression_ratio_flagship": round(
+            dcn_compression_ratio(1 << 20, slices), 2),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--record", action="store_true",
+                    help="write MULTISLICE_BENCH.json")
+    ap.add_argument("--slices", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=64,
+                    help="dp degree WITHIN one slice (default 64 — one "
+                         "v5e-64 slice)")
+    ap.add_argument("--model", default="gpt2-large")
+    ap.add_argument("--out", default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "MULTISLICE_BENCH.json"))
+    args = ap.parse_args()
+
+    proj = project(args.model, args.slices, args.dp)
+    h = proj["schedules"]["hierarchical"]
+    hc = proj["schedules"]["hierarchical_1bit_dcn"]
+    f = proj["schedules"]["flat"]
+    rec = {
+        "generated_by": "ablate_multislice.py",
+        "methodology": (
+            "ANALYTIC PROJECTION on the two-tier ring wire model + the "
+            "shared chip peak table — no TPU and no DCN on this box; "
+            "the CPU-mesh 'slices' the tests audit are virtual axes in "
+            "one host. The structural byte counts are compiled-program "
+            "truth (tools/comm_audit.py multislice flagship); the "
+            "times are model arithmetic, to be re-recorded measured on "
+            "a real multislice pod."),
+        "projection": proj,
+        # The record bench_gate diffs across rounds: hierarchical
+        # (active-schedule) DCN bytes/step — a rise means something
+        # started shipping more over the slow tier.
+        "multislice": {
+            "available": True,
+            "dcn_bytes_per_step": h["dcn_bytes_per_step"],
+            "dcn_bytes_per_step_compressed": hc["dcn_bytes_per_step"],
+            "flat_dcn_bytes_per_step": f["dcn_bytes_per_step"],
+            "ici_bytes_per_step": h["ici_bytes_per_step"],
+            "dcn_reduction_vs_flat": round(
+                f["dcn_bytes_per_step"] / max(1, h["dcn_bytes_per_step"]),
+                2),
+            "dcn_reduction_compressed_vs_dense": round(
+                h["dcn_bytes_per_step"] /
+                max(1, hc["dcn_bytes_per_step"]), 2),
+        },
+    }
+    print(json.dumps({k: rec["multislice"][k] for k in
+                      ("dcn_bytes_per_step",
+                       "dcn_bytes_per_step_compressed",
+                       "flat_dcn_bytes_per_step",
+                       "dcn_reduction_vs_flat",
+                       "dcn_reduction_compressed_vs_dense")}, indent=1))
+    for name, row in proj["schedules"].items():
+        print(f"[{name}] ici {row['ici_bytes_per_step']:,} B | dcn "
+              f"{row['dcn_bytes_per_step']:,} B | floor "
+              f"{row['projected_comm_floor_ms']} ms "
+              f"({row['comm_bound_tier']}-bound)")
+    if args.record:
+        with open(args.out, "w") as fobj:
+            json.dump(rec, fobj, indent=1)
+        print(f"[ablate_multislice] wrote {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

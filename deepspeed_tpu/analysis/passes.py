@@ -381,6 +381,52 @@ def collective_placement_pass(ctx: LintContext) -> List[LintFinding]:
                 if o.payload_bytes in scatterable]
     grad_rs = [o for o in ctx.audit.of_kind("reduce-scatter")
                if o.payload_bytes in scatterable]
+    # Factored replica hierarchy (multislice slices > 1, or the MoE
+    # explicit path's ep > 1): the LEGAL wire is an in-group reduce-
+    # scatter (groups of dp) + ONE outer-axis all-reduce (groups of
+    # `slices` / `ep`) carrying only the 1/dp residual payloads
+    # (dcn_shard_bytes). Whitelist that outer hop out of the
+    # grad-allreduce check — a shard payload can coincide byte-for-byte
+    # with a smaller leaf's full size. On multislice meshes additionally
+    # flag any grad-sized collective whose groups SPAN the slice axis
+    # (wider than dp): a flat joint-(slice, data) sync pushes grad-sized
+    # traffic over every DCN boundary link.
+    slices = int(meta.get("slices", 1) or 1)
+    ep = int(meta.get("ep", 1) or 1)
+    dp = int(meta.get("dp", 1) or 1)
+    outer = slices if slices > 1 else ep
+    dcn_shard = {int(b) for b in (meta.get("dcn_shard_bytes") or ())}
+    if outer > 1 and str(meta.get("grad_sync_mode")) == "explicit":
+        grad_ars = [o for o in grad_ars
+                    if not (o.group_size == outer
+                            and o.payload_bytes in dcn_shard)]
+    if slices > 1:
+        for o in ctx.audit.ops:
+            if o.kind not in ("all-reduce", "reduce-scatter"):
+                continue
+            if o.payload_bytes not in scatterable:
+                continue
+            # The whitelisted inter-slice hop itself: when slices > dp
+            # its groups are wider than dp while carrying only a 1/dp
+            # shard whose size collides with a smaller leaf's full size
+            # — same exclusion as the grad-allreduce check above.
+            if o.group_size == slices and o.payload_bytes in dcn_shard:
+                continue
+            if o.group_size > dp:
+                out.append(LintFinding(
+                    lint="collective_placement", path=ctx.name,
+                    key=f"grad-spans-dcn:{','.join(o.out_shapes)}",
+                    summary=(f"grad-sized {o.kind} of {o.out_shapes} in "
+                             f"groups of {o.group_size} (> dp={dp}) "
+                             f"spans the slice axis — a flat joint sync "
+                             "pushes grad-sized traffic over DCN; the "
+                             "hierarchy moves only the 1/dp residual "
+                             "there"),
+                    bytes=o.payload_bytes, wire_bytes=o.wire_bytes,
+                    priced=True, in_loop=o.in_loop,
+                    details={"op_name": o.op_name,
+                             "group_size": o.group_size,
+                             "dp": dp, "slices": slices}))
     if expects_rs:
         for o in grad_ars:
             out.append(LintFinding(

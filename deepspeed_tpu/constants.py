@@ -376,6 +376,14 @@ ZERO_GRAD_SYNC_MODES = ("auto", "declarative", "explicit")
 # consumes the knob; unstacked models gather leaf-at-use regardless.
 ZERO_PREFETCH_DEPTH = "prefetch_depth"
 ZERO_PREFETCH_DEPTH_DEFAULT = 1
+# Multi-slice DCN compression: 1-bit (error-feedback sign + per-chunk
+# scale) compression of the INTER-SLICE gradient hop only — the slow
+# DCN tier is where the 1-bit wire format (ops/onebit.py) pays; the
+# in-slice ICI reduce-scatter is never compressed. Requires a mesh with
+# slices > 1 (parallel/multislice.py) and the explicit hierarchical
+# grad path (ZeRO stage >= 2).
+ZERO_DCN_COMPRESSION = "dcn_compression"
+ZERO_DCN_COMPRESSION_DEFAULT = False
 ZERO_OVERLAP_COMM = "overlap_comm"
 ZERO_OVERLAP_COMM_DEFAULT = False
 ZERO_ALLGATHER_PARTITIONS = "allgather_partitions"
@@ -558,6 +566,10 @@ MESH_DATA_PARALLEL_SIZE = "data_parallel_size"
 MESH_MODEL_PARALLEL_SIZE = "model_parallel_size"
 MESH_PIPE_PARALLEL_SIZE = "pipe_parallel_size"
 MESH_SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
+# Multi-slice scale-out: how many ICI domains (slices) the mesh spans —
+# the OUTERMOST mesh axis; dp factors within a slice and only the
+# `slice`-axis collectives cross DCN (parallel/multislice.py).
+MESH_NUM_SLICES = "slices"
 
 #############################################
 # Checkpoint

@@ -72,8 +72,33 @@ def device_report(lines=None) -> list:
             peak = (f"no peak-table entry; roofline assumes {pk.name}"
                     if pk.assumed else
                     f"peak {pk.bf16_tflops:.0f} bf16 TFLOPs, "
-                    f"{pk.hbm_gbs:.0f} GB/s HBM, {pk.ici_gbs:.0f} GB/s ICI")
+                    f"{pk.hbm_gbs:.0f} GB/s HBM, {pk.ici_gbs:.0f} GB/s ICI, "
+                    f"{pk.dcn_gbs:.3g} GB/s DCN")
             out.append(f"  device {d.id}: {kind} ({peak})")
+        # The two interconnect tiers, side by side: multislice training
+        # prices them separately (a step can be DCN-bound while ICI
+        # idles — monitor/cost_model.py), so the operator should see the
+        # ~30-60x gap here, not discover it in a slow step.
+        pk0 = peaks_for_kind(getattr(devs[0], "device_kind", ""))
+        flag = " (ASSUMED v5e row)" if pk0.assumed else ""
+        out.append(
+            f"interconnect tiers ..... ICI {pk0.ici_gbs:.0f} GB/s/chip | "
+            f"DCN {pk0.dcn_gbs:.3g} GB/s/chip "
+            f"({pk0.ici_gbs / pk0.dcn_gbs:.0f}x slower){flag}")
+        # Resolved slice topology (DS_NUM_SLICES / multi-host env): how
+        # the process world maps onto ICI domains.
+        try:
+            from .monitor.hostinfo import process_identity, slice_identity
+            _, world = process_identity()
+            slice_id, rank_in_slice, n_slices = slice_identity()
+            out.append(
+                f"slice topology ......... {n_slices} slice(s) x "
+                f"{world // max(1, n_slices)} process(es)/slice"
+                + (f"; this process: slice {slice_id} rank "
+                   f"{rank_in_slice}" if n_slices > 1 else
+                   " (single ICI domain)"))
+        except Exception as e:
+            out.append(f"slice topology ......... unresolved: {e}")
         try:
             stats = devs[0].memory_stats()
             if stats and "bytes_limit" in stats:

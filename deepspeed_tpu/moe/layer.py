@@ -238,6 +238,26 @@ def moe_ffn(params: Dict[str, jnp.ndarray], x: jnp.ndarray, cfg,
                                gelu_approx, ep=1)
         return y.reshape(B, S, H), stats
 
+    if comm.axis_in_scope(EP_AXIS):
+        # Already INSIDE a fully-manual shard_map over (expert, data) —
+        # the engine's factored explicit-gradient path runs the whole
+        # loss that way so dense grads can reduce-scatter over `data`
+        # (the stage-2 declarative regression this closes). Params
+        # arrived as their expert-axis shards and ``x`` is the local
+        # batch slab: run the token path bare; dispatch/combine bind to
+        # the in-scope `expert` axis directly and the stats psum to
+        # global exactly like the self-wrapped path below.
+        y, stats = _moe_tokens(params, x.reshape(B * S, H), moe,
+                               gelu_approx, ep=ep)
+        axes = (EP_AXIS, DP_AXIS)
+        stats = {
+            "expert_tokens": lax.psum(stats["expert_tokens"], axes),
+            "drop_fraction": lax.pmean(stats["drop_fraction"], axes),
+            "aux_loss": lax.pmean(stats["aux_loss"], axes),
+            "z_loss": lax.pmean(stats["z_loss"], axes),
+        }
+        return y.reshape(B, S, H), stats
+
     if mesh is None:
         # No mesh (eval/serving on fully-addressable params —
         # gpt2_apply on a fetched tree): every expert is local, so the

@@ -21,16 +21,21 @@ fuses three existing sources into per-path roofline verdicts:
 3. **The PR-3 interconnect wire model** — per-step gradient-sync bytes
    at the engine's RESOLVED lowering.
 
-Per path the model prices three ceilings against the shared chip-peak
-table (peaks.py):
+Per path the model prices FOUR ceilings against the shared chip-peak
+table (peaks.py) — the interconnect is two-tier since multi-slice
+landed:
 
     t_compute = flops_per_device / bf16_peak
     t_hbm     = hbm_bytes_per_device / hbm_bandwidth
-    t_comm    = wire_bytes / ici_bandwidth
+    t_comm    = ici_wire_bytes / ici_bandwidth      (in-slice tier)
+    t_dcn     = dcn_wire_bytes / dcn_bandwidth      (inter-slice tier)
 
-and the verdict is the binding ceiling; ``max`` of the three is the
-analytic step-time floor (perfect-overlap roofline). MFU follows the
-same table: achieved flops/sec per device over the bf16 peak.
+and the verdict is the binding ceiling; ``max`` of the four is the
+analytic step-time floor (perfect-overlap roofline). The tiers are
+priced separately because their ceilings differ by 1-2 orders of
+magnitude: a multislice step can be DCN-bound while ICI idles, and one
+fused "comm" figure would hide exactly that. MFU follows the same
+table: achieved flops/sec per device over the bf16 peak.
 
 Everything here is REPORT-BOUNDARY work: building the model AOT-compiles
 each path once (host-side compile, no device traffic, no fences), so the
@@ -46,6 +51,7 @@ from .peaks import ChipPeaks, chip_peaks
 BOUND_COMPUTE = "compute"
 BOUND_HBM = "hbm"
 BOUND_INTERCONNECT = "interconnect"
+BOUND_DCN = "dcn"
 
 # analytic/XLA flops ratio above which the XLA figures are treated as a
 # scan undercount and scaled (a straight-line program sits near 1.0; a
@@ -136,19 +142,24 @@ def _bind_kwargs(fn: Callable, kwargs: Dict) -> Callable:
 
 
 def roofline(flops_per_device: float, hbm_bytes_per_device: float,
-             comm_bytes: float, peaks: ChipPeaks) -> Dict[str, Any]:
+             comm_bytes: float, peaks: ChipPeaks,
+             dcn_bytes: float = 0.0) -> Dict[str, Any]:
     """Roofline verdict for one path: which ceiling binds, and the
-    perfect-overlap analytic time floor."""
+    perfect-overlap analytic time floor. ``comm_bytes`` is the ICI
+    (in-slice) tier; ``dcn_bytes`` the inter-slice tier (0 on
+    single-slice meshes — the pre-multislice behavior exactly)."""
     t_compute = flops_per_device / peaks.flops_per_sec
     t_hbm = hbm_bytes_per_device / peaks.hbm_bytes_per_sec
     t_comm = comm_bytes / peaks.ici_bytes_per_sec
+    t_dcn = dcn_bytes / peaks.dcn_bytes_per_sec
     times = {BOUND_COMPUTE: t_compute, BOUND_HBM: t_hbm,
-             BOUND_INTERCONNECT: t_comm}
+             BOUND_INTERCONNECT: t_comm, BOUND_DCN: t_dcn}
     bound = max(times, key=times.get)
     return {
         "t_compute_ms": t_compute * 1e3,
         "t_hbm_ms": t_hbm * 1e3,
         "t_comm_ms": t_comm * 1e3,
+        "t_dcn_ms": t_dcn * 1e3,
         "bound": bound,
         "floor_ms": times[bound] * 1e3,
         # operational intensity (flops/byte) vs the machine balance point
@@ -162,7 +173,7 @@ def roofline(flops_per_device: float, hbm_bytes_per_device: float,
 
 def path_cost(name: str, fn: Callable, abstract_args: Tuple,
               abstract_kwargs: Dict, comm_bytes: float, n_devices: int,
-              peaks: ChipPeaks) -> Dict[str, Any]:
+              peaks: ChipPeaks, dcn_bytes: float = 0.0) -> Dict[str, Any]:
     """Fused per-path cost record: XLA + analytic counters, scan
     correction, roofline verdict."""
     xla = xla_cost_analysis(fn, abstract_args, abstract_kwargs)
@@ -173,6 +184,7 @@ def path_cost(name: str, fn: Callable, abstract_args: Tuple,
         "xla_available": xla is not None,
         "analytic_flops": analytic,
         "comm_bytes": int(comm_bytes),
+        "dcn_bytes": int(dcn_bytes),
     }
     if prof is not None and prof[1]:
         entry["top_modules"] = prof[1]
@@ -204,7 +216,8 @@ def path_cost(name: str, fn: Callable, abstract_args: Tuple,
         else 0.0
     entry["hbm_bytes_per_device"] = hbm_bytes
 
-    entry.update(roofline(flops_dev, hbm_bytes, comm_bytes, peaks))
+    entry.update(roofline(flops_dev, hbm_bytes, comm_bytes, peaks,
+                          dcn_bytes=dcn_bytes))
     entry["available"] = True
     return entry
 
@@ -225,14 +238,17 @@ def mfu(flops_per_step_total: float, step_time_s: float, n_devices: int,
 def build_cost_model(sentinel, comm_bytes_by_path: Dict[str, float],
                      step_paths: Dict[str, float], n_devices: int,
                      peaks: Optional[ChipPeaks] = None,
-                     extra_paths: Optional[Dict[str, Tuple]] = None
+                     extra_paths: Optional[Dict[str, Tuple]] = None,
+                     dcn_bytes_by_path: Optional[Dict[str, float]] = None
                      ) -> Dict[str, Any]:
     """The engine-facing entry point.
 
     - ``sentinel``: the RecompileSentinel whose registry holds every
       compiled step function with its recorded abstract signature.
-    - ``comm_bytes_by_path``: per-step wire-model bytes attributed to
-      each path (paths absent here price comm at 0).
+    - ``comm_bytes_by_path``: per-step ICI wire-model bytes attributed
+      to each path (paths absent here price comm at 0).
+    - ``dcn_bytes_by_path``: the inter-slice (DCN) tier, priced against
+      its own bandwidth ceiling — empty/absent on single-slice meshes.
     - ``step_paths``: {path_name: invocations_per_train_step} — which
       registered paths compose ONE optimizer step (e.g. the trio path
       runs grad_step gas× then apply_grads once).
@@ -254,14 +270,19 @@ def build_cost_model(sentinel, comm_bytes_by_path: Dict[str, float],
     for name, (fn, a_args, a_kwargs) in sources.items():
         paths[name] = path_cost(name, fn, a_args, a_kwargs,
                                 comm_bytes_by_path.get(name, 0.0),
-                                n_devices, peaks)
+                                n_devices, peaks,
+                                dcn_bytes=(dcn_bytes_by_path or {})
+                                .get(name, 0.0))
 
     # Fuse the paths that make up one optimizer step. Floors add across
     # sequentially-invoked programs (each path's internal ceilings can
     # overlap; distinct XLA programs cannot).
     step_flops = 0.0
     step_floor_ms = 0.0
-    ceiling_ms = {BOUND_COMPUTE: 0.0, BOUND_HBM: 0.0, BOUND_INTERCONNECT: 0.0}
+    ceiling_ms = {BOUND_COMPUTE: 0.0, BOUND_HBM: 0.0,
+                  BOUND_INTERCONNECT: 0.0, BOUND_DCN: 0.0}
+    _ceiling_key = {BOUND_COMPUTE: "t_compute_ms", BOUND_HBM: "t_hbm_ms",
+                    BOUND_INTERCONNECT: "t_comm_ms", BOUND_DCN: "t_dcn_ms"}
     missing: List[str] = []
     for name, weight in step_paths.items():
         p = paths.get(name)
@@ -275,7 +296,7 @@ def build_cost_model(sentinel, comm_bytes_by_path: Dict[str, float],
             step_flops += p["flops_per_device"] * n_devices * w
         step_floor_ms += p["floor_ms"] * w
         for k in ceiling_ms:
-            ceiling_ms[k] += p[f"t_{'comm' if k == BOUND_INTERCONNECT else k}_ms"] * w
+            ceiling_ms[k] += p.get(_ceiling_key[k], 0.0) * w
     step_bound = max(ceiling_ms, key=ceiling_ms.get) if step_floor_ms else None
     return {
         "chip": peaks.as_dict(),
@@ -295,4 +316,5 @@ def build_cost_model(sentinel, comm_bytes_by_path: Dict[str, float],
 __all__ = ["build_cost_model", "path_cost", "roofline", "mfu",
            "xla_cost_analysis", "analytic_flops", "analytic_profile",
            "abstract_args_of",
-           "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT"]
+           "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT",
+           "BOUND_DCN"]

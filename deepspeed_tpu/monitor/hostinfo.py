@@ -11,7 +11,12 @@ nothing — explicitly, with a logged notice instead of a silent drop.
 
 ``DS_PROC_INDEX`` / ``DS_PROC_COUNT`` override the jax-reported identity
 (test/bench hook: exercising the multi-host shard + aggregation path on
-a single-process CPU mesh without a real pod).
+a single-process CPU mesh without a real pod). ``DS_NUM_SLICES`` layers
+the multi-slice topology on top: processes enumerate slice-major (slice
+0's hosts first — matching the mesh's outermost ``slice`` axis), so
+``slice_identity()`` maps the flat process index to (slice_id,
+rank-in-slice) and the two-slice emulated world is just
+DS_PROC_COUNT=4 DS_NUM_SLICES=2 over four single-host invocations.
 """
 from __future__ import annotations
 
@@ -32,6 +37,31 @@ def process_identity() -> Tuple[int, int]:
         return jax.process_index(), jax.process_count()
     except Exception:
         return 0, 1
+
+
+def slice_identity(num_slices: Optional[int] = None
+                   ) -> Tuple[int, int, int]:
+    """(slice_id, rank_in_slice, num_slices) for this process.
+
+    ``num_slices`` defaults to ``$DS_NUM_SLICES`` (1 when unset — the
+    single-slice world every pre-multislice consumer assumed). Processes
+    enumerate slice-major: with P processes and S slices, process p sits
+    in slice ``p // (P/S)`` at in-slice rank ``p % (P/S)`` — the same
+    outermost-slice order ``build_mesh(slices=...)`` lays devices out
+    in. A process count not divisible by the slice count is a topology
+    error, said plainly."""
+    rank, world = process_identity()
+    if num_slices is None:
+        num_slices = int(os.environ.get("DS_NUM_SLICES", "1"))
+    if num_slices <= 1:
+        return 0, rank, 1
+    if world % num_slices != 0:
+        raise ValueError(
+            f"process count {world} not divisible by num_slices="
+            f"{num_slices} (DS_NUM_SLICES): every slice must hold the "
+            "same number of hosts")
+    per_slice = world // num_slices
+    return rank // per_slice, rank % per_slice, num_slices
 
 
 def resolve_writer(is_writer: Optional[bool] = None,
@@ -61,4 +91,5 @@ def shard_path(path: str, rank: int) -> str:
     return f"{root}.rank{rank}{ext}"
 
 
-__all__ = ["process_identity", "resolve_writer", "shard_path"]
+__all__ = ["process_identity", "slice_identity", "resolve_writer",
+           "shard_path"]

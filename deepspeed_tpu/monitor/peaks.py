@@ -11,15 +11,25 @@ The figures are rough public per-chip specs by TPU generation:
   the MFU denominator);
 - ``hbm_gbs``: HBM bandwidth, GB/s (the memory-roofline ceiling);
 - ``ici_gbs``: aggregate inter-chip interconnect bandwidth per chip, GB/s
-  one-way (the communication-roofline ceiling for ring collectives).
+  one-way (the communication-roofline ceiling for ring collectives
+  WITHIN one slice).
+- ``dcn_gbs``: per-chip share of the host's data-center-network NIC,
+  GB/s one-way — the SECOND communication tier, what inter-slice
+  collectives ride in a multislice deployment. These are rough
+  deployment-dependent figures (host NIC bandwidth divided by chips per
+  host), one to two orders of magnitude below ICI — which is the whole
+  point of the hierarchical sync: the two tiers must be priced
+  separately or the roofline lies (a step can be DCN-bound while ICI
+  idles).
 
 They are CEILINGS for roofline verdicts and utilisation fractions, not
 measurements — real programs see lower effective bandwidth (stride
-patterns, link contention). On non-TPU backends (CPU dev meshes) there is
-no meaningful peak; ``chip_peaks()`` returns the v5e row flagged
-``assumed=True`` so downstream math stays total-ordered and every
-consumer can say "vs an ASSUMED v5e peak" instead of crashing or silently
-printing garbage.
+patterns, link contention), and the DCN column doubly so (it depends on
+the NIC provisioning of the actual pod). On non-TPU backends (CPU dev
+meshes) there is no meaningful peak; ``chip_peaks()`` returns the v5e
+row flagged ``assumed=True`` so downstream math stays total-ordered and
+every consumer can say "vs an ASSUMED v5e peak" instead of crashing or
+silently printing garbage.
 """
 from __future__ import annotations
 
@@ -44,6 +54,15 @@ TPU_ICI_GBS: Dict[str, float] = {
     "v4": 300.0, "v5e": 200.0, "v5p": 600.0, "v6e": 448.0,
 }
 
+# Per-chip share of the host DCN NIC, GB/s one-way: rough figures from
+# ~100-200 Gbps host NICs over 4-8 chips per host (deployment-dependent
+# — these are two-tier-roofline ceilings for the inter-slice hop, not
+# specs; a real pod's provisioning should overwrite the verdict with a
+# measured figure). Note the ratio to ICI: 30-60x slower per chip.
+TPU_DCN_GBS: Dict[str, float] = {
+    "v4": 6.25, "v5e": 6.25, "v5p": 12.5, "v6e": 12.5,
+}
+
 _DEFAULT_GEN = "v5e"
 
 
@@ -54,6 +73,7 @@ class ChipPeaks:
     bf16_tflops: float
     hbm_gbs: float
     ici_gbs: float
+    dcn_gbs: float = TPU_DCN_GBS["v5e"]
     assumed: bool = False      # True when the device kind had no table row
 
     @property
@@ -67,6 +87,10 @@ class ChipPeaks:
     @property
     def ici_bytes_per_sec(self) -> float:
         return self.ici_gbs * 1e9
+
+    @property
+    def dcn_bytes_per_sec(self) -> float:
+        return self.dcn_gbs * 1e9
 
     def as_dict(self) -> Dict[str, object]:
         return dataclasses.asdict(self)
@@ -87,7 +111,7 @@ def peaks_for_kind(device_kind: str) -> ChipPeaks:
     key, assumed = (gen, False) if gen else (_DEFAULT_GEN, True)
     return ChipPeaks(name=key, bf16_tflops=TPU_PEAK_TFLOPS[key],
                      hbm_gbs=TPU_HBM_GBS[key], ici_gbs=TPU_ICI_GBS[key],
-                     assumed=assumed)
+                     dcn_gbs=TPU_DCN_GBS[key], assumed=assumed)
 
 
 def chip_peaks(device=None) -> ChipPeaks:
@@ -105,5 +129,5 @@ def chip_peak_tflops() -> float:
     return chip_peaks().bf16_tflops
 
 
-__all__ = ["TPU_PEAK_TFLOPS", "TPU_HBM_GBS", "TPU_ICI_GBS", "ChipPeaks",
-           "peaks_for_kind", "chip_peaks", "chip_peak_tflops"]
+__all__ = ["TPU_PEAK_TFLOPS", "TPU_HBM_GBS", "TPU_ICI_GBS", "TPU_DCN_GBS",
+           "ChipPeaks", "peaks_for_kind", "chip_peaks", "chip_peak_tflops"]

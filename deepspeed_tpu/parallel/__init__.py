@@ -1,5 +1,7 @@
 from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology, PipelineParallelGrid,
-                       build_mesh, DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS)
+                       build_mesh, DP_AXIS, MP_AXIS, PP_AXIS, SP_AXIS,
+                       EP_AXIS, SLICE_AXIS)
 from . import comm
 from . import hlo_audit
+from . import multislice

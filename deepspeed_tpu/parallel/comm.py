@@ -66,6 +66,36 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
                       **kw)
 
 
+def axis_in_scope(axis_name: str) -> bool:
+    """True when ``axis_name`` is bound as a MANUAL axis at the current
+    trace point (i.e. we are inside a shard_map/pmap over it, so
+    ``lax.psum(axis_name)`` / ``lax.all_to_all(axis_name)`` are legal
+    directly). Layers that normally wrap themselves in their own
+    shard_map (the MoE FFN) use this to detect they are ALREADY inside
+    one — the engine's factored explicit-gradient path runs the whole
+    loss under a fully-manual shard_map over (expert, data) — and run
+    their collectives bare instead of nesting. Version-portable: probes
+    the axis env through whichever introspection this jax exposes;
+    an un-probe-able jax answers False (callers then take the
+    self-wrapping path, which is always correct outside a shard_map)."""
+    try:
+        from jax import core
+        if hasattr(core, "axis_frame"):            # jax <= 0.4.x
+            core.axis_frame(axis_name)
+            return True
+        if hasattr(core, "get_axis_env"):          # newer jax
+            return core.get_axis_env().axis_exists(axis_name)
+    except NameError:
+        return False
+    except Exception:
+        pass
+    try:
+        from jax import core
+        return axis_name in core.unsafe_get_axis_names_DO_NOT_USE()
+    except Exception:
+        return False
+
+
 def pvary(x, axis_name):
     """Mark ``x`` as varying over a manual mesh axis. New jax spells this
     ``lax.pcast(..., to="varying")``; older releases have no such marking

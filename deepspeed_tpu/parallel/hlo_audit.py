@@ -364,7 +364,9 @@ def grad_sync_wire_model(params: Any, dp: int,
                          gas: int = 1,
                          param_specs: Any = None,
                          mesh: Any = None,
-                         moe: Optional[Dict[str, Any]] = None
+                         moe: Optional[Dict[str, Any]] = None,
+                         slices: int = 1,
+                         dcn_compression: bool = False
                          ) -> Dict[str, Any]:
     """Analytic per-step gradient-sync wire bytes for a param tree under
     dp-way data parallelism, in both lowerings. Scatterable leaves follow
@@ -395,6 +397,29 @@ def grad_sync_wire_model(params: Any, dp: int,
     The term is reported separately, NOT folded into the grad-sync
     figures: it is activation wire, and the engine sums the two for its
     per-step total.
+
+    ``slices > 1``: the multi-slice HIERARCHICAL schedule
+    (parallel/multislice.py) — the output grows the two-tier terms:
+
+    - ``ici_wire_bytes``: the in-slice sync (reduce-scatter of
+      scatterable + all-reduce of the replicated tail, over ``dp``) —
+      identical to the single-slice reduce-scatter figure;
+    - ``dcn_payload_bytes``: the per-rank residual that crosses slices
+      (the 1/dp shard + the replicated tail, f32);
+    - ``dcn_wire_bytes``: its inter-slice ring all-reduce over
+      ``slices`` — ONE per step (shards accumulate locally across
+      micro-steps; only the accumulated residual crosses DCN);
+    - ``dcn_wire_bytes_compressed``: the same hop in the 1-bit packed
+      wire format (sign bits + per-chunk f32 scales,
+      ops/onebit.comm_bytes) — what ``dcn_compression`` actually ships;
+    - ``flat_dcn_link_bytes``: the comparator — a FLAT collective over
+      the joint (slice, data) ring carries ~the full grad payload over
+      every link including the DCN boundary links; hierarchy divides
+      the DCN traffic by dp.
+
+    The headline total ``hierarchical_wire_bytes`` = ici + dcn (the
+    active dcn figure per ``dcn_compression``). slices > 1 excludes
+    ``zero3`` (not composed).
     """
     import jax
     from .topology import DP_AXIS
@@ -407,7 +432,7 @@ def grad_sync_wire_model(params: Any, dp: int,
     else:
         spec_leaves = [None] * len(leaves)
     scatterable = replicated = 0
-    scatterable_el = 0
+    scatterable_el = replicated_el = 0
     for leaf, sp in zip(leaves, spec_leaves):
         shape = getattr(leaf, "shape", None)
         if shape is None or getattr(leaf, "ndim", 0) < 1:
@@ -437,6 +462,7 @@ def grad_sync_wire_model(params: Any, dp: int,
             scatterable_el += nel
         else:
             replicated += nbytes
+            replicated_el += nel
     repl_wire = ring_wire_bytes("all-reduce", replicated, dp)
     out = {
         "dp": dp,
@@ -461,6 +487,31 @@ def grad_sync_wire_model(params: Any, dp: int,
             "zero3_wire_bytes":
                 int(gas) * (out["reduce_scatter_wire_bytes"]
                             + 2 * one_gather),
+        })
+    if slices > 1:
+        assert not zero3, "multislice wire model: zero3 not composed"
+        from .multislice import dcn_comm_bytes
+        # Per-rank residual after the in-slice reduce: the 1/dp shard of
+        # every scatterable leaf + the replicated tail, f32.
+        dcn_el = scatterable_el // dp + replicated_el
+        dcn_payload = dcn_el * 4
+        dcn_wire = ring_wire_bytes("all-reduce", dcn_payload, slices)
+        dcn_payload_c = dcn_comm_bytes(dcn_el, compressed=True,
+                                       num_slices=slices)
+        dcn_wire_c = ring_wire_bytes("all-reduce", dcn_payload_c, slices)
+        active_dcn = dcn_wire_c if dcn_compression else dcn_wire
+        out.update({
+            "slices": slices,
+            "dcn_compression": bool(dcn_compression),
+            "ici_wire_bytes": out["reduce_scatter_wire_bytes"],
+            "dcn_payload_bytes": int(dcn_payload),
+            "dcn_wire_bytes": int(dcn_wire),
+            "dcn_wire_bytes_compressed": int(dcn_wire_c),
+            # A flat joint-(slice, data) ring pushes ~the full payload
+            # over EVERY link, DCN boundary links included.
+            "flat_dcn_link_bytes": int(scatterable + replicated),
+            "hierarchical_wire_bytes":
+                int(out["reduce_scatter_wire_bytes"] + active_dcn),
         })
     if moe is not None:
         m = moe_alltoall_wire_model(**moe)

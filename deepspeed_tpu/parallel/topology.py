@@ -127,12 +127,24 @@ SP_AXIS = "seq"
 # their grads sync over `data` WITHIN an expert group only; the MoE
 # all-to-all dispatch/combine rides this axis (deepspeed_tpu/moe/).
 EP_AXIS = "expert"
+# Multi-slice scale-out: the `slice` axis is OUTERMOST — members of one
+# slice are joined by fast ICI, distinct slices only by slow DCN. Data
+# parallelism factors WITHIN a slice (the batch shards over
+# (slice, data) jointly, replica count = slices * dp), ZeRO shards over
+# `data` within a slice, and gradient sync is HIERARCHICAL: in-slice
+# reduce-scatter over ICI, then an inter-slice all-reduce over DCN that
+# moves only the 1/dp-sharded residual (parallel/multislice.py).
+#
+# NOT to be confused with the reference's "slice parallel" accessors on
+# PipelineParallelGrid below, which alias MODEL (tensor-slicing)
+# parallelism and are deprecated under that name.
+SLICE_AXIS = "slice"
 
 
 def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
-               ep: int = 1, devices=None,
-               axis_order: Tuple[str, ...] = (PP_AXIS, EP_AXIS, DP_AXIS,
-                                              SP_AXIS, MP_AXIS)):
+               ep: int = 1, slices: int = 1, devices=None,
+               axis_order: Tuple[str, ...] = (SLICE_AXIS, PP_AXIS, EP_AXIS,
+                                              DP_AXIS, SP_AXIS, MP_AXIS)):
     """Build a ``jax.sharding.Mesh`` with named axes over available devices.
 
     dp=None infers the remainder of the device count. Axis order places mp
@@ -142,6 +154,9 @@ def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
     of the dp device set, so the all-to-all groups are dp-stride
     neighborhoods and a (expert, data)-sharded batch enumerates the same
     global order the plain dp mesh used.
+    ``slices`` (multi-slice scale-out) is OUTERMOST: devices of one slice
+    stay contiguous (they really share an ICI domain), dp factors within
+    a slice, and only the `slice`-axis collectives cross DCN.
     """
     import jax
     from jax.sharding import Mesh
@@ -150,11 +165,12 @@ def build_mesh(dp: Optional[int] = None, mp: int = 1, pp: int = 1, sp: int = 1,
         devices = jax.devices()
     n = len(devices)
     if dp is None:
-        denom = mp * pp * sp * ep
+        denom = mp * pp * sp * ep * slices
         assert n % denom == 0, \
-            f"{n} devices not divisible by mp*pp*sp*ep={denom}"
+            f"{n} devices not divisible by mp*pp*sp*ep*slices={denom}"
         dp = n // denom
-    sizes = {PP_AXIS: pp, EP_AXIS: ep, DP_AXIS: dp, SP_AXIS: sp, MP_AXIS: mp}
+    sizes = {SLICE_AXIS: slices, PP_AXIS: pp, EP_AXIS: ep, DP_AXIS: dp,
+             SP_AXIS: sp, MP_AXIS: mp}
     total = int(np.prod(list(sizes.values())))
     assert total == n, f"mesh {sizes} needs {total} devices, have {n}"
     shape = tuple(sizes[a] for a in axis_order)
@@ -182,7 +198,6 @@ class PipelineParallelGrid:
         self.data_parallel_size = max(1, topology.get_dim("data"))
         self.pipe_parallel_size = max(1, topology.get_dim("pipe"))
         self.model_parallel_size = max(1, topology.get_dim("model"))
-        self.slice_parallel_size = self.model_parallel_size
         self.data_parallel_id = getattr(coord, "data", 0) if "data" in topology.axes else 0
         self.stage_id = getattr(coord, "pipe", 0) if "pipe" in topology.axes else 0
         self.model_parallel_id = getattr(coord, "model", 0) if "model" in topology.axes else 0
@@ -227,14 +242,37 @@ class PipelineParallelGrid:
     def get_model_parallel_group(self) -> str:
         return MP_AXIS
 
-    # --- slice parallel (reference alias for model parallel, topology.py:445-455) ---
+    # --- deprecated "slice parallel" alias -------------------------------
+    # The reference's topology.py:445-455 spells MODEL (tensor-slicing)
+    # parallelism "slice parallel". Since the multi-slice scale-out work
+    # introduced a REAL `slice` mesh axis (SLICE_AXIS: ICI domains joined
+    # by DCN — nothing to do with tensor slicing), that name is a footgun:
+    # these shims keep the reference API alive but warn and delegate to
+    # the model-parallel accessors, which are the real names.
+    def _warn_slice_parallel_alias(self, name: str) -> None:
+        import warnings
+        warnings.warn(
+            f"PipelineParallelGrid.{name}() is the reference's alias for "
+            f"MODEL (tensor-slicing) parallelism — it is unrelated to the "
+            f"'{SLICE_AXIS}' mesh axis (multi-slice DCN scale-out). Use "
+            f"the get_model_parallel_* accessors.",
+            DeprecationWarning, stacklevel=3)
+
+    @property
+    def slice_parallel_size(self) -> int:
+        self._warn_slice_parallel_alias("slice_parallel_size")
+        return self.model_parallel_size
+
     def get_slice_parallel_rank(self) -> int:
+        self._warn_slice_parallel_alias("get_slice_parallel_rank")
         return self.model_parallel_id
 
     def get_slice_parallel_world_size(self) -> int:
+        self._warn_slice_parallel_alias("get_slice_parallel_world_size")
         return self.model_parallel_size
 
     def get_slice_parallel_group(self) -> str:
+        self._warn_slice_parallel_alias("get_slice_parallel_group")
         return MP_AXIS
 
     # --- pipeline ---

@@ -405,6 +405,13 @@ class MeshConfig:
         self.model_parallel_size = get(d, C.MESH_MODEL_PARALLEL_SIZE, 1)
         self.pipe_parallel_size = get(d, C.MESH_PIPE_PARALLEL_SIZE, 1)
         self.sequence_parallel_size = get(d, C.MESH_SEQUENCE_PARALLEL_SIZE, 1)
+        # Multi-slice scale-out: ICI domains joined by DCN; the `slice`
+        # mesh axis is OUTERMOST and dp factors within a slice.
+        self.num_slices = get(d, C.MESH_NUM_SLICES, 1)
+        if not isinstance(self.num_slices, int) or self.num_slices < 1:
+            raise DeepSpeedConfigError(
+                f"{C.MESH}.{C.MESH_NUM_SLICES} must be a positive int "
+                f"(ICI domains the mesh spans), got {self.num_slices!r}")
 
 
 class DeepSpeedConfig:

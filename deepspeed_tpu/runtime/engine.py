@@ -56,7 +56,8 @@ from ..monitor import Telemetry
 from ..monitor.memory import analytic_state_bytes
 from ..ops.optimizers import build_optimizer
 from ..parallel import comm
-from ..parallel.topology import build_mesh, DP_AXIS, EP_AXIS, MP_AXIS
+from ..parallel.topology import (build_mesh, DP_AXIS, EP_AXIS, MP_AXIS,
+                                 SLICE_AXIS)
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 
@@ -177,7 +178,7 @@ class EngineState:
     stay dependency-light and serialization-friendly."""
 
     def __init__(self, step, params, opt_state, loss_scale, growth_count, hysteresis,
-                 skipped_steps, cast_params=None):
+                 skipped_steps, cast_params=None, dcn_error=None):
         self.step = step
         self.params = params
         self.opt_state = opt_state
@@ -193,12 +194,21 @@ class EngineState:
         # are replaced from outside (checkpoint load), so it can never
         # serve stale weights.
         self.cast_params = cast_params
+        # Multi-slice DCN-compression error feedback (None unless
+        # zero_optimization.dcn_compression is live): per-leaf
+        # [slices, *shard] f32 buffers — each (slice, dp-rank) carries
+        # the residual its 1-bit-compressed inter-slice transmissions
+        # have not yet delivered (parallel/multislice.py). Like 1-bit
+        # Adam's worker_error, it is genuinely per-member state; unlike
+        # it, it is NOT checkpointed (a resume restarts the feedback at
+        # zero — a one-step compression bias, self-correcting).
+        self.dcn_error = dcn_error
 
     def replace(self, **kw) -> "EngineState":
         d = dict(step=self.step, params=self.params, opt_state=self.opt_state,
                  loss_scale=self.loss_scale, growth_count=self.growth_count,
                  hysteresis=self.hysteresis, skipped_steps=self.skipped_steps,
-                 cast_params=self.cast_params)
+                 cast_params=self.cast_params, dcn_error=self.dcn_error)
         d.update(kw)
         return EngineState(**d)
 
@@ -206,7 +216,8 @@ class EngineState:
 jax.tree_util.register_pytree_node(
     EngineState,
     lambda s: ((s.step, s.params, s.opt_state, s.loss_scale, s.growth_count,
-                s.hysteresis, s.skipped_steps, s.cast_params), None),
+                s.hysteresis, s.skipped_steps, s.cast_params, s.dcn_error),
+               None),
     lambda _, ch: EngineState(*ch))
 
 
@@ -249,7 +260,15 @@ class DeepSpeedEngine:
         # ep * dp, while ZeRO keeps sharding over `data` (within-expert-
         # group) and expert weights shard over `expert`.
         self.ep_size = int(self.mesh.shape.get(EP_AXIS, 1))
-        self.replica_size = self.dp_size * self.ep_size
+        # Multi-slice scale-out: the `slice` axis is OUTERMOST (ICI
+        # domains joined by DCN); dp factors WITHIN a slice, so the
+        # batch-replica count is slices * ep * dp while ZeRO keeps
+        # sharding over `data` (within one slice) and gradient sync goes
+        # hierarchical (in-slice reduce-scatter over ICI, inter-slice
+        # all-reduce of the 1/dp shards over DCN —
+        # parallel/multislice.py).
+        self.slice_size = int(self.mesh.shape.get(SLICE_AXIS, 1))
+        self.replica_size = self.dp_size * self.ep_size * self.slice_size
 
         self.config = DeepSpeedConfig(config, mpu=mpu,
                                       world_size=self.replica_size) \
@@ -267,6 +286,8 @@ class DeepSpeedEngine:
                 f"moe.expert_parallel_size={self._moe.expert_parallel_size}"
                 f" but the mesh '{EP_AXIS}' axis has size {self.ep_size} —"
                 " build the mesh with build_mesh(ep=...) to match")
+        self._dcn_compression = bool(
+            self.config.zero_config.dcn_compression)
         self._validate_engine_config()
 
         self.loss_fn, init_params = self._normalize_model(model, model_params)
@@ -519,6 +540,8 @@ class DeepSpeedEngine:
         offload = self._offload is not None
         use_cast_cache = self._use_cast_cache
         compute_dtype = self.compute_dtype
+        dcn_live = self._dcn_compression and self.slice_size > 1
+        n_slices = self.slice_size
 
         def _init_state(params):
             return EngineState(
@@ -531,6 +554,10 @@ class DeepSpeedEngine:
                 skipped_steps=jnp.asarray(0, jnp.int32),
                 cast_params=_cast_floats(params, compute_dtype)
                 if use_cast_cache else None,
+                dcn_error=jax.tree_util.tree_map(
+                    lambda p: jnp.zeros(
+                        (n_slices,) + tuple(getattr(p, "shape", ())),
+                        jnp.float32), params) if dcn_live else None,
             )
 
         self.state = jax.jit(
@@ -644,11 +671,15 @@ class DeepSpeedEngine:
             meta=dict(
                 dp=self.dp_size,
                 ep=self.ep_size,
+                slices=self.slice_size,
                 zero_stage=self.zero_optimization_stage(),
                 precision=self.config.precision_dtype,
                 cpu_offload=self._offload is not None,
                 grad_sync_mode=self._grad_sync_mode,
                 wire_bytes_per_step=self._wire_bytes,
+                wire_bytes_ici=self._wire_bytes - self._wire_bytes_dcn,
+                wire_bytes_dcn=self._wire_bytes_dcn,
+                dcn_compression=self._dcn_compression,
                 wire_detail=self._wire_detail,
                 train_batch_size=self.train_batch_size(),
                 gradient_accumulation_steps=
@@ -718,7 +749,7 @@ class DeepSpeedEngine:
     # Construction helpers
     # ------------------------------------------------------------------ #
     def _build_mesh(self, config) -> Mesh:
-        mp = pp = sp = ep = 1
+        mp = pp = sp = ep = slices = 1
         if isinstance(config, str):
             from .config_utils import load_config_json
             config = load_config_json(config)
@@ -727,6 +758,7 @@ class DeepSpeedEngine:
             mp, pp, sp = (mc.model_parallel_size or 1, mc.pipe_parallel_size or 1,
                           mc.sequence_parallel_size or 1)
             ep = config.moe_config.expert_parallel_size or 1
+            slices = mc.num_slices or 1
         elif isinstance(config, dict):
             mesh_cfg = config.get(C.MESH, {})
             mp = mesh_cfg.get(C.MESH_MODEL_PARALLEL_SIZE, 1) or 1
@@ -734,7 +766,8 @@ class DeepSpeedEngine:
             sp = mesh_cfg.get(C.MESH_SEQUENCE_PARALLEL_SIZE, 1) or 1
             ep = config.get(C.MOE, {}).get(
                 C.MOE_EXPERT_PARALLEL_SIZE, 1) or 1
-        return build_mesh(mp=mp, pp=pp, sp=sp, ep=ep)
+            slices = mesh_cfg.get(C.MESH_NUM_SLICES, 1) or 1
+        return build_mesh(mp=mp, pp=pp, sp=sp, ep=ep, slices=slices)
 
     def _validate_engine_config(self) -> None:
         # Stage 3 (parameter partitioning) goes PAST the reference, which
@@ -765,6 +798,52 @@ class DeepSpeedEngine:
                 raise ValueError(
                     "moe expert_parallel_size > 1 composes with the main "
                     f"train path only; drop {', '.join(blockers)}")
+        if self.slice_size > 1:
+            # Multi-slice scale-out composes with the MAIN train path on
+            # a (slice, data) mesh under ZeRO stage >= 2 only: the
+            # hierarchical sync's DCN saving IS the in-slice reduce-
+            # scatter (1/dp of the grads cross slices) — dense modes
+            # would ship grad-sized trees over DCN and every other path
+            # computes grads without the slice axis in scope (silently
+            # missing the inter-slice reduction entirely).
+            blockers = []
+            if self.zero_optimization_stage() < 2:
+                blockers.append("zero_optimization.stage >= 2 (got "
+                                f"{self.zero_optimization_stage()})")
+            if not self.config.zero_config.reduce_scatter:
+                blockers.append("reduce_scatter: true")
+            if self.zero_optimization_stage() >= 3:
+                blockers.append("stage <= 2 (stage-3 x multislice not "
+                                "composed yet)")
+            if self.ep_size > 1:
+                blockers.append("expert_parallel_size == 1")
+            if self._direct_grads_fn is not None:
+                blockers.append("no pipeline grads_fn (1F1B)")
+            if self.config.zero_config.cpu_offload:
+                blockers.append("no zero_optimization.cpu_offload")
+            if self.config.sparse_gradients_enabled:
+                blockers.append("no sparse_gradients")
+            if (self.config.optimizer_name or "").lower() == \
+                    C.ONEBIT_ADAM_OPTIMIZER:
+                blockers.append("no OnebitAdam (dcn_compression is the "
+                                "multislice home of the 1-bit wire)")
+            # param_shardings (TP layouts) are re-checked when the grad
+            # sync resolves — _param_specs is bound after this runs.
+            if getattr(self, "_param_specs", None) is not None:
+                blockers.append("no tensor-parallel param_shardings")
+            for ax, size in self.mesh.shape.items():
+                if ax not in (SLICE_AXIS, DP_AXIS) and int(size) > 1:
+                    blockers.append(f"'{ax}' axis of size 1 (got {size})")
+            if blockers:
+                raise ValueError(
+                    f"mesh slices={self.slice_size} (hierarchical "
+                    "ICI/DCN gradient sync) requires: "
+                    + "; ".join(blockers))
+        if self._dcn_compression and self.slice_size <= 1:
+            raise ValueError(
+                "zero_optimization.dcn_compression requires a multi-"
+                "slice mesh (mesh.slices > 1 / build_mesh(slices=...)): "
+                "there is no DCN hop to compress on a single slice")
 
     def _normalize_model(self, model, model_params) -> Tuple[Callable, Any]:
         """Accept a flax module or a loss callable; return loss_fn(params,
@@ -854,32 +933,81 @@ class DeepSpeedEngine:
             return "none"
         if not zc.reduce_scatter:
             return "allreduce"
-        # The explicit path wraps the grad computation in a shard_map
-        # over dp only: paths with their own grad programs (1F1B direct
-        # grads, onebit, sparse-CSR) and meshes with additional live
-        # axes (TP/PP/SP, where dp-manual + rest-auto is a partial-auto
-        # shard_map) keep the declarative constraint. The offload grad
-        # pass routes through the same explicit builder since stage 3
-        # landed (its bucket regroup happens OUTSIDE the shard_map) —
-        # this is what retired the last lint waiver
-        # (collective_placement:offload_grad_step:grad-allreduce).
+        # The explicit path wraps the grad computation in a fully-manual
+        # shard_map over the REPLICA axes — plain dp, or the factored
+        # (slice, data) / (expert, data) meshes (each leaf psum_scatters
+        # over `data`, then the residual all-reduces over the outer
+        # axis: the hierarchical DCN hop / the cross-expert-group dense
+        # sync). Paths with their own grad programs (1F1B direct grads,
+        # onebit, sparse-CSR) and meshes with additional live axes
+        # (TP/PP/SP, where replica-manual + rest-auto is a partial-auto
+        # shard_map) keep the declarative constraint. param_shardings
+        # compose iff every spec is expert-only (the MoE layout — the
+        # factored path slices those at the shard_map boundary); TP
+        # layouts do not. The offload grad pass routes through the same
+        # explicit builder since stage 3 landed (its bucket regroup
+        # happens OUTSIDE the shard_map) — this is what retired the last
+        # lint waiver (collective_placement:offload_grad_step:
+        # grad-allreduce).
+        replica_axes = (DP_AXIS, SLICE_AXIS, EP_AXIS)
+        specs_ok = self._param_specs is None
+        if not specs_ok and self.ep_size > 1:
+            from ..moe.sharding import is_expert_spec
+
+            def spec_manual_ok(sp) -> bool:
+                if not isinstance(sp, P):
+                    return False
+                if is_expert_spec(sp):
+                    return True
+                # Entries over size-1 mesh axes are no-op shardings (the
+                # gpt2 TP specs name `model` even on an mp=1 mesh).
+                for entry in sp:
+                    for ax in ((entry,) if isinstance(entry, str)
+                               else (entry or ())):
+                        if int(self.mesh.shape.get(ax, 1)) > 1:
+                            return False
+                return True
+
+            spec_leaves = jax.tree_util.tree_leaves(
+                self._param_specs, is_leaf=lambda x: isinstance(x, P))
+            specs_ok = all(spec_manual_ok(sp) for sp in spec_leaves)
         explicit_ok = (
-            self._param_specs is None and not self._onebit
+            specs_ok and not self._onebit
             and not self.config.sparse_gradients_enabled
             and self._direct_grads_fn is None
             and all(int(self.mesh.shape[a]) == 1
-                    for a in self.mesh.axis_names if a != DP_AXIS))
+                    for a in self.mesh.axis_names
+                    if a not in replica_axes))
         mode = zc.grad_sync
+        if self.slice_size > 1:
+            # Hierarchical sync EXISTS only on the explicit path (a
+            # declarative lowering would emit whatever flat collective
+            # GSPMD picks over the joint axes — grad-sized DCN traffic).
+            if mode == "declarative" or not explicit_ok:
+                raise ValueError(
+                    "a multi-slice mesh (slices > 1) requires the "
+                    "explicit hierarchical gradient path: set "
+                    "zero_optimization.grad_sync to 'auto' or "
+                    "'explicit' on a (slice, data) mesh with the main "
+                    "train/offload path")
+            return "explicit"
         if mode == "explicit":
             if not explicit_ok:
                 raise ValueError(
                     "zero_optimization.grad_sync='explicit' supports the "
-                    "main train and offload paths on a pure-dp mesh only "
-                    "(no TP/PP/SP axes, onebit, sparse_gradients, or "
-                    "pipeline grads_fn) — use 'auto' or 'declarative'")
+                    "main train and offload paths on a pure-dp (or "
+                    "slice/expert-factored) mesh only (no TP/PP/SP axes, "
+                    "onebit, sparse_gradients, or pipeline grads_fn) — "
+                    "use 'auto' or 'declarative'")
             return "explicit"
         if mode == "declarative" or not explicit_ok:
             return "declarative"
+        if self.ep_size > 1:
+            # The declarative lowering for the (expert, data)-sharded
+            # batch regresses to all-reduce + slice on this backend
+            # (audited in COMM_AUDIT.json's moe flagship history) — the
+            # factored explicit path closes it; no probe needed.
+            return "explicit"
         from ..parallel import hlo_audit
         lowering = hlo_audit.zero2_grad_sync_lowering(self.mesh, DP_AXIS)
         return "declarative" if lowering == "reduce-scatter" else "explicit"
@@ -890,9 +1018,40 @@ class DeepSpeedEngine:
         actually runs. One source of truth for the init log, the
         telemetry meta/records, and bench's dp_comm provenance."""
         self._wire_model = None
+        # Two-tier split: everything is ICI wire except the inter-slice
+        # hop of the hierarchical multislice sync (the only collective
+        # in-tree that rides DCN).
+        self._wire_bytes_dcn = 0
         if self.replica_size <= 1:
             return 0, "single replica (no gradient sync)"
         from ..parallel import hlo_audit
+        if self.slice_size > 1:
+            model = hlo_audit.grad_sync_wire_model(
+                self.state.params, self.dp_size, slices=self.slice_size,
+                dcn_compression=self._dcn_compression)
+            self._wire_model = model
+            dcn = model["dcn_wire_bytes_compressed"] \
+                if self._dcn_compression else model["dcn_wire_bytes"]
+            self._wire_bytes_dcn = int(dcn)
+            # The tiers are per-STEP in the same units: the in-slice
+            # scatter runs once per micro-step inside the gas scan
+            # (x gas), the DCN hop once per step on the accumulated
+            # shard — summing a per-micro ICI term with a per-step DCN
+            # term would misreport which tier binds.
+            gas = self._scan_microbatches()
+            ici = int(model["ici_wire_bytes"]) * int(gas)
+            comp = (" 1-bit-compressed (packed sign bits + per-chunk "
+                    "scales — the DCN wire format; the emulation psums "
+                    "decompressed values)") if self._dcn_compression \
+                else ""
+            return int(ici + dcn), \
+                (f"hierarchical {self._grad_sync_mode}: in-slice "
+                 f"reduce-scatter over ICI (dp={self.dp_size}, "
+                 f"x{gas} micro-steps) + inter-slice all-reduce over "
+                 f"DCN (slices={self.slice_size}) of the 1/dp residual "
+                 f"only{comp} — {int(dcn):,} DCN B/step vs "
+                 f"{model['flat_dcn_link_bytes']:,} grad-sized for a "
+                 f"flat joint sync")
         if self.ep_size > 1:
             return self._moe_wire_bytes(hlo_audit)
         if self._sparse_mask is not None:
@@ -1003,25 +1162,40 @@ class DeepSpeedEngine:
             gas=self._scan_microbatches())
         model = dict(hlo_audit.grad_sync_wire_model(
             dense_leaves, self.dp_size, moe=moe_kw))
+        # Only the EXPLICIT factored path earns the hierarchical
+        # pricing: RS over data per micro-step, then the cross-group
+        # all-reduce carries the 1/dp RESIDUAL only (pricing it at full
+        # size would overstate the expert hop dp x). A user-pinned
+        # declarative stage-2 keeps the regressed full all-reduce
+        # figure — that IS what it compiles to on this backend.
         stage2_rs = self.zero_optimization_stage() >= 2 and \
-            self._grad_sync_mode in ("declarative", "explicit")
+            self._grad_sync_mode == "explicit"
         if stage2_rs and self.dp_size > 1:
             dense_wire = (
-                ring("all-reduce", model["scatterable_bytes"],
-                     self.ep_size)
-                + ring("reduce-scatter", model["scatterable_bytes"],
+                ring("reduce-scatter", model["scatterable_bytes"],
+                     self.dp_size)
+                + ring("all-reduce",
+                       model["scatterable_bytes"] // self.dp_size,
+                       self.ep_size)
+                + ring("all-reduce", model["replicated_bytes"],
                        self.dp_size)
                 + ring("all-reduce", model["replicated_bytes"],
-                       self.replica_size))
-            dense_note = (f"dense grads all-reduce over expert "
-                          f"({self.ep_size}) + reduce-scatter over data "
-                          f"({self.dp_size})")
+                       self.ep_size))
+            dense_note = (f"dense grads reduce-scatter over data "
+                          f"({self.dp_size}) + all-reduce their 1/dp "
+                          f"residual across expert groups "
+                          f"({self.ep_size})")
         else:
             dense_wire = ring("all-reduce", model["grad_bytes"],
                               self.replica_size)
             dense_note = (f"dense grads all-reduce over expert x data "
                           f"({self.replica_size})")
-        expert_wire = ring("all-reduce", expert_local, self.dp_size)
+        # Expert grads sync over data-within-group only; under the
+        # stage >= 2 explicit factored path they reduce-scatter there
+        # (the declared dp dim layered onto the expert base spec), under
+        # dense modes they all-reduce.
+        expert_wire = ring("reduce-scatter" if stage2_rs else "all-reduce",
+                           expert_local, self.dp_size)
         a2a = int(model.get("moe_alltoall_wire_bytes") or 0)
         # The honest dense-baseline comparator the init log prints: one
         # all-reduce of EVERYTHING (expert grads replicated across
@@ -1034,9 +1208,10 @@ class DeepSpeedEngine:
                      dense_grad_wire_bytes=int(dense_wire))
         self._wire_model = model
         per_tok = model["moe"]["wire_bytes_per_token"]
+        expert_sync = "reduce-scatter" if stage2_rs else "all-reduce"
         detail = (
             f"{self._grad_sync_mode} MoE ep={self.ep_size}: {dense_note}; "
-            f"expert grads ({expert_local:,} B/device) all-reduce over "
+            f"expert grads ({expert_local:,} B/device) {expert_sync} over "
             f"data within their expert group only; dispatch/combine "
             f"all-to-all {per_tok:,} B/token"
             + (f" = {a2a:,} B/step" if a2a
@@ -1072,6 +1247,8 @@ class DeepSpeedEngine:
         tl = self.telemetry
         if tl.enabled:
             tl.meta["wire_bytes_per_step"] = self._wire_bytes
+            tl.meta["wire_bytes_ici"] = \
+                self._wire_bytes - self._wire_bytes_dcn
             tl.meta["wire_detail"] = self._wire_detail
             if isinstance(self._wire_model, dict) and \
                     "moe" in self._wire_model:
@@ -1088,6 +1265,14 @@ class DeepSpeedEngine:
                 "are overlapped by XLA's latency-hiding scheduler "
                 "automatically; the knob only selects the bucketed host "
                 "pipeline under cpu_offload", ranks=[0])
+        if self.slice_size > 1:
+            log_dist(f"Multi-slice scale-out: {self._wire_detail}; "
+                     f"~{self._wire_bytes:,} wire bytes/step "
+                     f"({self._wire_bytes - self._wire_bytes_dcn:,} ICI + "
+                     f"{self._wire_bytes_dcn:,} DCN; "
+                     f"slices={self.slice_size} x dp={self.dp_size})",
+                     ranks=[0])
+            return
         if self.ep_size > 1:
             log_dist(f"MoE expert parallelism: {self._wire_detail}; "
                      f"~{self._wire_bytes:,} wire bytes/step "
@@ -1213,11 +1398,28 @@ class DeepSpeedEngine:
         else:
             opt_sh = repl(opt_state)
         scalar = NamedSharding(self.mesh, P())
+        # DCN-compression error feedback: per-leaf [slices, *leaf] f32,
+        # slice-sharded on the leading axis (genuinely per-slice state)
+        # and dp-sharded where the grad shard is (same _leaf_spec rule,
+        # shifted one dim right) — each (slice, dp-rank) owns exactly
+        # the residual of its own compressed transmissions.
+        dcn_sh = None
+        if getattr(self, "_dcn_compression", False) and \
+                self.slice_size > 1:
+            from .zero.partition import _leaf_spec
+
+            def err_sharding(p):
+                if not hasattr(p, "shape") or getattr(p, "ndim", 0) < 1:
+                    return NamedSharding(self.mesh, P(SLICE_AXIS))
+                spec = _leaf_spec(p.shape, self.dp_size, DP_AXIS)
+                return NamedSharding(self.mesh, P(SLICE_AXIS, *spec))
+            dcn_sh = jax.tree_util.tree_map(err_sharding, params)
         return EngineState(step=scalar, params=params_sh, opt_state=opt_sh,
                            loss_scale=scalar, growth_count=scalar,
                            hysteresis=scalar, skipped_steps=scalar,
                            cast_params=(params_sh if self._use_cast_cache
-                                        else None))
+                                        else None),
+                           dcn_error=dcn_sh)
 
     def _metrics_shardings(self, with_taps: bool = False,
                            with_moe: bool = False
@@ -1267,8 +1469,15 @@ class DeepSpeedEngine:
     def _batch_sharding(self, batch_tree, leading_dims: int = 1):
         """Shard batch arrays over the replica axes on the (micro-)batch
         dim — (expert, data) jointly when expert parallelism is live
-        (expert factors out of data), plain dp otherwise."""
-        batch_axes = (EP_AXIS, DP_AXIS) if self.ep_size > 1 else DP_AXIS
+        (expert factors out of data), (slice, data) jointly on a
+        multi-slice mesh (slices factor OUTSIDE data, matching the
+        outermost mesh axis), plain dp otherwise."""
+        if self.slice_size > 1:
+            batch_axes = (SLICE_AXIS, DP_AXIS)
+        elif self.ep_size > 1:
+            batch_axes = (EP_AXIS, DP_AXIS)
+        else:
+            batch_axes = DP_AXIS
 
         def spec(x):
             pspec = P(*([None] * (leading_dims - 1) + [batch_axes]))
@@ -1418,8 +1627,8 @@ class DeepSpeedEngine:
                 theta = pld.theta_at(step.astype(jnp.float32)) \
                     if accepts_pld else None
                 keys = jax.random.split(rng, gas)
-                grads, mean_loss, _aux = explicit(params, micro_batches,
-                                                  keys, scale, theta)
+                grads, mean_loss, _aux, _err = explicit(
+                    params, micro_batches, keys, scale, theta)
                 grads = jax.tree_util.tree_map(
                     lambda g: g.astype(wire_dtype), grads)
                 return regroup(grads), mean_loss
@@ -2018,6 +2227,24 @@ class DeepSpeedEngine:
         (the hlo_audit probe caught the declared sharding lowering to a
         full all-reduce + slice on this backend).
 
+        FACTORED replica meshes generalize the schedule hierarchically
+        (parallel/multislice.py): the shard_map goes fully manual over
+        (outer, data) where outer is the ``slice`` axis (multi-slice
+        scale-out) or the ``expert`` axis (MoE), each leaf reduce-
+        scatters over ``data`` INSIDE the gas scan exactly as before,
+        and the accumulated 1/dp residual crosses the outer axis ONCE
+        per step: slices all-reduce it over DCN (optionally 1-bit-
+        compressed with carried error feedback —
+        ``zero_optimization.dcn_compression``), expert groups all-reduce
+        the DENSE leaves across groups while expert-sharded leaves
+        (their grads are already per-expert) skip the outer hop
+        entirely. The loss-mean correction divides by the FULL replica
+        count (outer * dp), exact for power-of-two worlds — which makes
+        one 2-slice step on a slice-duplicated batch BIT-identical to
+        the single-slice step from the same state
+        (tests/test_multislice.py; multi-step trajectories meet the
+        usual cross-program few-ulp FMA limit).
+
         ``scaled_loss(params, mb, key, scale, theta) -> (scaled, raw)``
         is differentiated HERE. Under stage 2 it receives the full
         (replicated / cast-cached) params and the explicit scatter runs
@@ -2041,20 +2268,41 @@ class DeepSpeedEngine:
         f32 ulp: the two lowerings' collectives sum rank partials in
         different orders (ring reduce-scatter rotates each shard's start
         rank), the same cross-program limit PR 1 documented for FMA
-        contraction. RNG: per-rank dropout streams via ``fold_in(rank)``,
-        like the onebit/sparse shard_map paths.
-        Returns ``fn(params, micro_batches, keys, scale, theta) ->
-        (dp-sharded f32 grads, mean_loss)``.
+        contraction. RNG: per-rank dropout streams via ``fold_in(rank)``
+        (the joint replica index on factored meshes), like the onebit/
+        sparse shard_map paths.
+        Returns ``fn(params, micro_batches, keys, scale, theta,
+        dcn_error=None) -> (dp-sharded f32 grads, mean_loss, aux,
+        new_dcn_error)`` — ``new_dcn_error`` is None unless DCN
+        compression is live.
         """
+        from ..parallel.multislice import inter_slice_allreduce
         shard_map = comm.shard_map
         mesh, dp = self.mesh, self.dp_size
         accepts_pld = self._accepts_pld
         zero3 = self._zero3
+        # The factored outer replica axis (None on a plain-dp mesh):
+        # `slice` (multi-slice, DCN tier) or `expert` (MoE groups).
+        if self.slice_size > 1:
+            outer_axis, outer = SLICE_AXIS, self.slice_size
+        elif self.ep_size > 1:
+            outer_axis, outer = EP_AXIS, self.ep_size
+        else:
+            outer_axis, outer = None, 1
+        replicas = dp * outer
+        moe_manual = self.ep_size > 1
+        dcn_compress = self._dcn_compression and outer_axis == SLICE_AXIS
         leaves, treedef = jax.tree_util.tree_flatten(grad_sh)
         dims_tree = jax.tree_util.tree_unflatten(
             treedef, [_spec_axis(sh, DP_AXIS) for sh in leaves])
         grad_out_specs = jax.tree_util.tree_unflatten(
             treedef, [sh.spec for sh in leaves])
+        # Expert-sharded grads (spec on the `expert` axis) already live
+        # per expert group — they take the in-group `data` reduction
+        # only, never the outer hop (experts are not replicas).
+        outer_skip = jax.tree_util.tree_unflatten(
+            treedef, [_spec_axis(sh, EP_AXIS) is not None
+                      for sh in leaves])
         if zero3:
             # Params enter AS SHARDS (the stage-3 layout == the grad
             # layout, so the same spec tree serves both directions).
@@ -2081,7 +2329,12 @@ class DeepSpeedEngine:
 
             grad_fn = jax.value_and_grad(loss_for_grad, has_aux=True)
         else:
-            param_in_specs = P()
+            # MoE factored mesh: expert-sharded params enter AS their
+            # expert-axis shards (the fully-manual shard_map slices them
+            # at the boundary; moe_ffn detects the in-scope axes via
+            # comm.axis_in_scope and runs its collectives bare).
+            param_in_specs = self._param_specs \
+                if moe_manual and self._param_specs is not None else P()
             grad_fn = jax.value_and_grad(scaled_loss, has_aux=True)
 
         def scatter_leaf(g, d):
@@ -2106,20 +2359,77 @@ class DeepSpeedEngine:
             return jax.tree_util.tree_map(scatter_leaf, g, dims_tree)
 
         def reduce_aux(aux):
-            # Aux stats are computed on each rank's LOCAL tokens here
-            # (the MoE layer runs its ep==1 path inside this shard_map —
-            # ep > 1 never resolves to the explicit mode): counts sum
-            # over dp, the rest mean.
+            # Aux stats computed on each rank's LOCAL tokens (the MoE
+            # layer's ep==1 path inside this shard_map): counts sum
+            # over EVERY replica axis in scope — dp, plus the slice
+            # axis on a multislice mesh (an ep=1 MoE model composes
+            # with slices; reducing over dp alone would report one
+            # slice's counts as global) — the rest mean. On the
+            # FACTORED (expert, data) mesh the layer's manual path
+            # already psum/pmean'd its stats over both axes —
+            # re-reducing would double-count.
             if not isinstance(aux, dict) or "moe" not in aux:
                 return aux
+            if moe_manual:
+                return aux
+            axes = (DP_AXIS,) if outer_axis is None \
+                else (DP_AXIS, outer_axis)
             moe = dict(aux["moe"])
             for k, v in moe.items():
-                moe[k] = lax.psum(v, DP_AXIS) if k == "expert_tokens" \
-                    else lax.pmean(v, DP_AXIS)
+                moe[k] = lax.psum(v, axes) if k == "expert_tokens" \
+                    else lax.pmean(v, axes)
             return {**aux, "moe": moe}
 
-        def per_rank(params, micro_batches, keys, scale, theta):
+        skip_leaves = [bool(s) for s in
+                       jax.tree_util.tree_leaves(outer_skip)]
+
+        def outer_reduce(g, err, scale):
+            """The once-per-step outer hop on the accumulated 1/dp
+            residual: slices all-reduce over DCN (optionally 1-bit-
+            compressed with error feedback), expert groups all-reduce
+            the dense leaves across groups; expert-sharded leaves pass
+            through. Compression runs in UNSCALED units: the grads here
+            are still loss-scaled (downstream unscales at the update),
+            but the carried error feedback must not be denominated in a
+            scale that the dynamic scaler changes under it — so the
+            shard divides by ``scale`` before compressing and the
+            summed result multiplies back (both exact: the loss scale
+            is a power of two; a traced 1.0 for non-fp16). Returns
+            (reduced grads, new error tree | None)."""
+            if outer_axis is None:
+                return g, None
+            g_leaves = treedef.flatten_up_to(g)
+            err_leaves = treedef.flatten_up_to(err) if dcn_compress \
+                else [None] * len(g_leaves)
+            inv_scale = 1.0 / scale
+            out, errs = [], []
+            for gl, sk, el in zip(g_leaves, skip_leaves, err_leaves):
+                if sk:
+                    out.append(gl)
+                    errs.append(el)
+                    continue
+                if dcn_compress:
+                    # el enters as this slice's [1, *shard] slab of the
+                    # [slices, *shard] error buffer.
+                    summed, ne = inter_slice_allreduce(
+                        gl * inv_scale, el[0], num_slices=outer,
+                        compress=True)
+                    out.append(summed * scale)
+                    errs.append(ne[None])
+                else:
+                    out.append(lax.psum(gl, outer_axis))
+                    errs.append(None)
+            new_err = jax.tree_util.tree_unflatten(treedef, errs) \
+                if dcn_compress else None
+            return jax.tree_util.tree_unflatten(treedef, out), new_err
+
+        def per_rank(params, micro_batches, keys, scale, theta,
+                     dcn_error=None):
             rank = lax.axis_index(DP_AXIS)
+            if outer_axis is not None:
+                # Joint replica index: distinct dropout streams per
+                # (outer member, dp rank), slice-major like the mesh.
+                rank = lax.axis_index(outer_axis) * dp + rank
             keys = jax.vmap(lambda k: jax.random.fold_in(k, rank))(keys)
             theta_arg = theta if accepts_pld else None
             if zero3:
@@ -2170,24 +2480,55 @@ class DeepSpeedEngine:
                 # micro-step mean (None stays None).
                 aux = jax.tree_util.tree_map(
                     lambda a: jnp.mean(a, axis=0), aux_stack)
-            # loss_fn normalizes over its LOCAL shard, so the summed grads
-            # and losses are dp x the global-mean values; /dp is exact for
-            # power-of-two dp (bit-parity with the declarative path).
-            g = jax.tree_util.tree_map(lambda x: x / dp, g)
-            loss = lax.psum(loss, DP_AXIS) / dp
+            # loss_fn normalizes over its LOCAL shard, so the summed
+            # grads and losses are replicas x the global-mean values;
+            # /replicas is exact for power-of-two worlds (bit-parity
+            # with the declarative path, and — via the exact scaling —
+            # of a slice-duplicated 2-slice run with the 1-slice run).
+            # The outer hop happens AFTER the division, ONCE on the
+            # accumulated shard: the DCN hop costs 1/dp of the grads per
+            # STEP, not per micro-step.
+            g = jax.tree_util.tree_map(lambda x: x / replicas, g)
+            g, new_err = outer_reduce(g, dcn_error, scale)
+            loss = lax.psum(loss, DP_AXIS)
+            if outer_axis is not None:
+                loss = lax.psum(loss, outer_axis)
+            loss = loss / replicas
+            if dcn_compress:
+                return g, loss, reduce_aux(aux), new_err
             return g, loss, reduce_aux(aux)
 
-        def explicit_grads(params, micro_batches, keys, scale, theta):
+        batch_axes = (outer_axis, DP_AXIS) if outer_axis is not None \
+            else DP_AXIS
+        err_specs = jax.tree_util.tree_unflatten(
+            treedef, [P(SLICE_AXIS, *sh.spec) for sh in leaves]) \
+            if dcn_compress else None
+
+        def explicit_grads(params, micro_batches, keys, scale, theta,
+                           dcn_error=None):
             batch_specs = jax.tree_util.tree_map(
-                lambda _: P(None, DP_AXIS), micro_batches)
+                lambda _: P(None, batch_axes), micro_batches)
             theta_in = theta if theta is not None \
                 else jnp.zeros((), jnp.float32)
-            fn = shard_map(per_rank, mesh=mesh,
-                           in_specs=(param_in_specs, batch_specs, P(),
-                                     P(), P()),
-                           out_specs=(grad_out_specs, P(), P()),
-                           check_vma=False)
-            return fn(params, micro_batches, keys, scale, theta_in)
+            in_specs = (param_in_specs, batch_specs, P(), P(), P())
+            out_specs = (grad_out_specs, P(), P())
+            if dcn_compress:
+                if dcn_error is None:
+                    raise ValueError(
+                        "dcn_compression is live but no error-feedback "
+                        "state was passed (state.dcn_error)")
+                fn = shard_map(per_rank, mesh=mesh,
+                               in_specs=in_specs + (err_specs,),
+                               out_specs=out_specs + (err_specs,),
+                               check_vma=False)
+                g, loss, aux, new_err = fn(params, micro_batches, keys,
+                                           scale, theta_in, dcn_error)
+                return g, loss, aux, new_err
+            fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, check_vma=False)
+            g, loss, aux = fn(params, micro_batches, keys, scale,
+                              theta_in)
+            return g, loss, aux, None
 
         return explicit_grads
 
@@ -2281,6 +2622,7 @@ class DeepSpeedEngine:
                     micro_batches)
 
             loss_params = state.cast_params if use_cache else state.params
+            new_dcn_error = None
             if direct_grads is not None:
                 # Manual-VJP model (1F1B pipeline): one call yields loss
                 # AND grads; it consumes all micro-batches itself. Params
@@ -2298,9 +2640,12 @@ class DeepSpeedEngine:
             elif explicit_grads_fn is not None:
                 # Guaranteed reduce-scatter: grads leave the shard_map
                 # already dp-sharded and f32 (no constraint needed — the
-                # out_specs ARE the ZeRO-2 layout).
-                grads, mean_loss, aux = explicit_grads_fn(
-                    loss_params, micro_batches, keys, scale, theta)
+                # out_specs ARE the ZeRO-2 layout). On multi-slice
+                # meshes this is the HIERARCHICAL path; with DCN
+                # compression the error-feedback buffers thread through.
+                grads, mean_loss, aux, new_dcn_error = explicit_grads_fn(
+                    loss_params, micro_batches, keys, scale, theta,
+                    state.dcn_error)
             elif gas == 1:
                 # Fast path: no accumulation scan — saves a full zero-init +
                 # add pass over the fp32 grad tree every step. Master-free
@@ -2409,10 +2754,16 @@ class DeepSpeedEngine:
                                             new_cast)
 
             # Shared overflow-vote resolution: step/skip bookkeeping +
-            # loss-scale state machine.
+            # loss-scale state machine. DCN-compression error feedback
+            # commits only on a taken step (an overflow must not poison
+            # the feedback with garbage residuals — the onebit rule).
+            new_dcn = state.dcn_error
+            if new_dcn_error is not None:
+                new_dcn = _tree_select(overflow, state.dcn_error,
+                                       new_dcn_error)
             new_state = state.replace(
                 params=new_params, opt_state=new_opt_state,
-                cast_params=new_cast,
+                cast_params=new_cast, dcn_error=new_dcn,
                 **_overflow_resolution(state, overflow, **scaler_kw))
             metrics = {
                 "loss": mean_loss,
@@ -2670,15 +3021,24 @@ class DeepSpeedEngine:
             step_paths = self._cost_model_step_paths()
             # Wire bytes are PER STEP; price them on the grad-computing
             # path, split per invocation so the step total reconciles.
+            # Two tiers: the inter-slice DCN hop is priced against its
+            # own (much lower) bandwidth ceiling — a step can be
+            # DCN-bound while ICI idles.
             comm: Dict[str, float] = {}
+            dcn: Dict[str, float] = {}
+            ici_bytes = self._wire_bytes - self._wire_bytes_dcn
             for p in ("train_step", "offload_grad_step",
                       "sparse_grad_step", "grad_step"):
                 if p in step_paths and self._wire_bytes:
-                    comm[p] = float(self._wire_bytes) / step_paths[p]
+                    comm[p] = float(ici_bytes) / step_paths[p]
+                    if self._wire_bytes_dcn:
+                        dcn[p] = float(self._wire_bytes_dcn) / \
+                            step_paths[p]
                     break
             payload = build_cost_model(
                 tl.sentinel, comm_bytes_by_path=comm,
-                step_paths=step_paths, n_devices=int(self.mesh.size))
+                step_paths=step_paths, n_devices=int(self.mesh.size),
+                dcn_bytes_by_path=dcn)
             pricing = self._optimizer_apply_pricing()
             if pricing is not None:
                 payload["optimizer_apply"] = pricing
@@ -2862,6 +3222,41 @@ class DeepSpeedEngine:
                     if payload >= 64 * 1024 and \
                             payload not in dense_payloads:
                         expert_bytes.add(payload)
+        # Factored replica meshes: the per-rank payloads the OUTER-axis
+        # hop may legally carry — the 1/dp shard of every scatterable
+        # dense leaf and the full replicated tail (f32; the compressed
+        # DCN emulation psums the same shapes). collective_placement
+        # whitelists outer-group all-reduces of these (a shard payload
+        # can coincide byte-for-byte with a smaller leaf's full size)
+        # and, on multislice meshes, flags anything grad-sized spanning
+        # the slice axis (a flat joint sync over DCN). Expert-sharded
+        # leaves are excluded — they never take the outer hop and have
+        # their own check.
+        dcn_shard_bytes: set = set()
+        outer_factored = self.slice_size > 1 or (
+            self.ep_size > 1 and
+            getattr(self, "_grad_sync_mode", "none") == "explicit")
+        if outer_factored:
+            all_leaves = jax.tree_util.tree_leaves(self.state.params)
+            if self._param_specs is not None and self.ep_size > 1:
+                from ..moe.sharding import is_expert_spec
+                spec_l = jax.tree_util.tree_structure(
+                    self.state.params).flatten_up_to(self._param_specs)
+            else:
+                is_expert_spec = None
+                spec_l = [None] * len(all_leaves)
+            for l, sp in zip(all_leaves, spec_l):
+                if not hasattr(l, "shape"):
+                    continue
+                if sp is not None and is_expert_spec is not None \
+                        and is_expert_spec(sp):
+                    continue
+                n = int(l.size)
+                if any(s is not None for s in
+                       _leaf_spec(l.shape, self.dp_size, DP_AXIS)):
+                    dcn_shard_bytes.add(n // self.dp_size * 4)
+                else:
+                    dcn_shard_bytes.add(n * 4)
         return {
             "grad_sync_path": name in grad_paths,
             "grad_sync_mode": getattr(self, "_grad_sync_mode", "none"),
@@ -2874,6 +3269,8 @@ class DeepSpeedEngine:
             "largest_leaf_bytes": int(largest_leaf),
             "dp": self.dp_size,
             "ep": self.ep_size,
+            "slices": self.slice_size,
+            "dcn_shard_bytes": sorted(dcn_shard_bytes),
             "expert_leaf_bytes": sorted(expert_bytes),
             "expert_group_size": self.dp_size,
             "zero_stage": self.zero_optimization_stage(),
@@ -2985,6 +3382,12 @@ class DeepSpeedEngine:
                 "OnebitAdam supports train_batch() only: the compressed "
                 "allreduce lives inside the fused step, which the "
                 "forward/backward/step split cannot drive")
+        if self._dcn_compression:
+            raise NotImplementedError(
+                "zero_optimization.dcn_compression supports train_batch()"
+                " only: the error-feedback buffers thread through the "
+                "fused step, which the forward/backward/step split "
+                "cannot drive")
         if self._grad_step_fn is None:
             self._build_grad_paths()
         if getattr(self, "_trio_t0", None) is None:
@@ -3079,8 +3482,8 @@ class DeepSpeedEngine:
                 # has no metrics dict for MoE stats to ride — aux drops
                 # (the aux LOSS is already inside raw_loss).
                 mb1 = jax.tree_util.tree_map(lambda x: x[None], mb)
-                g, loss, _aux = explicit_fn(params, mb1, key[None],
-                                            scale, theta)
+                g, loss, _aux, _err = explicit_fn(params, mb1, key[None],
+                                                  scale, theta)
                 return g, loss
             (_, (raw_loss, _aux)), grads = vg(params, mb, key, scale,
                                               theta)
@@ -3350,7 +3753,12 @@ class DeepSpeedEngine:
             with open(meta_file) as f:
                 meta = json.load(f)
 
-        host_state = jax.device_get(self.state.replace(cast_params=None))
+        # cast_params is re-derived by _place_state; dcn_error is not
+        # checkpointed (it resets to zero on resume) — fetching either
+        # here would pull full-model-sized trees device-to-host for
+        # nothing.
+        host_state = jax.device_get(self.state.replace(cast_params=None,
+                                                       dcn_error=None))
         if load_optimizer_states and \
                 type(host_state.opt_state).__name__ == "FusedAdamState" \
                 and int(meta.get("fused_moment_layout", 1)) != 2:

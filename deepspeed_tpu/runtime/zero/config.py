@@ -45,6 +45,7 @@ class ZeroConfig:
         self.reduce_scatter = C.ZERO_REDUCE_SCATTER_DEFAULT
         self.grad_sync = C.ZERO_GRAD_SYNC_DEFAULT
         self.prefetch_depth = C.ZERO_PREFETCH_DEPTH_DEFAULT
+        self.dcn_compression = C.ZERO_DCN_COMPRESSION_DEFAULT
         self.reduce_bucket_size = C.ZERO_REDUCE_BUCKET_SIZE_DEFAULT
         self.allgather_partitions = C.ZERO_ALLGATHER_PARTITIONS_DEFAULT
         self.allgather_bucket_size = C.ZERO_ALLGATHER_BUCKET_SIZE_DEFAULT
@@ -91,6 +92,19 @@ class ZeroConfig:
                 f"{C.ZERO_PREFETCH_DEPTH} must be a non-negative int "
                 f"(layers gathered ahead of use), got "
                 f"{self.prefetch_depth!r}")
+        self.dcn_compression = get(d, C.ZERO_DCN_COMPRESSION,
+                                   C.ZERO_DCN_COMPRESSION_DEFAULT)
+        if not isinstance(self.dcn_compression, bool):
+            raise ValueError(
+                f"{C.ZERO_DCN_COMPRESSION} must be a bool (compress the "
+                f"inter-slice DCN gradient hop), got "
+                f"{self.dcn_compression!r}")
+        if self.dcn_compression and self.stage < 2:
+            raise ValueError(
+                f"{C.ZERO_DCN_COMPRESSION} requires ZeRO stage >= 2: the "
+                "compressed DCN hop carries the 1/dp-sharded residual of "
+                "the in-slice reduce-scatter, which only exists when "
+                "grads are sharded")
         self.overlap_comm = get(d, C.ZERO_OVERLAP_COMM, C.ZERO_OVERLAP_COMM_DEFAULT)
         self.allgather_partitions = get(d, C.ZERO_ALLGATHER_PARTITIONS,
                                         C.ZERO_ALLGATHER_PARTITIONS_DEFAULT)

@@ -395,7 +395,8 @@ class TestBenchGate:
         none_srv = {"serve_tps": None, "ttft_p95": None,
                     "kernel_speedup": None, "zero3_overlap": None,
                     "health": None, "hbm_per_token": None,
-                    "accept_rate": None, "moe_drop": None}
+                    "accept_rate": None, "moe_drop": None,
+                    "dcn_bytes": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}

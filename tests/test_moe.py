@@ -482,6 +482,74 @@ class TestMoEEngine:
 # --------------------------------------------------------------------- #
 # Tooling: bench_gate parses and gates the MoE drop fraction
 # --------------------------------------------------------------------- #
+class TestFactoredExplicitStage2:
+    """ROADMAP 4(b), closed: dense grads on the (expert, data) mesh
+    reduce-scatter over `data` instead of regressing to the declarative
+    all-reduce + slice — the explicit psum_scatter builder extended to
+    factored meshes (the same outer-axis machinery the multislice
+    hierarchical sync uses; tools/comm_audit.py's moe flagship records
+    the closure)."""
+
+    def test_stage2_resolves_explicit_and_reduce_scatters(self):
+        engine, cfg, mesh = build_engine(moe8(), stage=2)
+        assert engine._grad_sync_mode == "explicit"
+        batch = copy_batches(1, 32, seed=0)[0]
+        mb = engine._stack_micro_batches(batch)
+        mb = jax.device_put(mb,
+                            engine._batch_sharding(mb, leading_dims=2))
+        audit = hlo_audit.audit_jit(engine._build_train_step(),
+                                    engine.state, mb, engine._base_rng)
+        rs = audit.of_kind("reduce-scatter")
+        assert rs, "stage-2 factored path compiled no reduce-scatter"
+        assert all(o.group_size == engine.dp_size for o in rs)
+        # The regression's signature — a DIVISIBLE dense leaf's full-
+        # size all-reduce — must be gone. (Shard-size collisions are
+        # excluded, as in the comm_audit flagship.)
+        from deepspeed_tpu.runtime.zero.partition import _leaf_spec
+        spec_leaves = jax.tree_util.tree_structure(
+            engine.state.params).flatten_up_to(engine._param_specs)
+        dense_div, shards = set(), set()
+        for l, sp in zip(jax.tree_util.tree_leaves(engine.state.params),
+                         spec_leaves):
+            if is_expert_spec(sp):
+                continue
+            n = int(l.size) * 4
+            if any(s is not None for s in
+                   _leaf_spec(l.shape, engine.dp_size, DP_AXIS)):
+                dense_div.add(n)
+                shards.add(n // engine.dp_size)
+        bad = [o for o in audit.of_kind("all-reduce")
+               if o.payload_bytes in (dense_div - shards)]
+        assert not bad, [(o.payload_bytes, o.group_size) for o in bad]
+        # The a2a family is untouched by the grad-path change (the
+        # scanned-layer model carries the fwd pair + bwd transposes
+        # once inside the loop body).
+        a2a = audit.of_kind("all-to-all")
+        assert len(a2a) == 4 and all(o.in_loop for o in a2a)
+
+    def test_explicit_matches_declarative_stage1_first_step(self):
+        """Same model, same batch: the factored explicit stage-2 step
+        produces the same loss and near-identical params as the
+        stage-1 declarative step (different collective associations —
+        the usual few-ulp cross-program limit; the mean-correction
+        arithmetic must agree exactly at f32 display precision)."""
+        batch = copy_batches(1, 32, seed=3)[0]
+        e2, *_ = build_engine(moe8(), stage=2, seed=1)
+        e1, *_ = build_engine(moe8(), stage=1, seed=1)
+        assert e2._grad_sync_mode == "explicit"
+        l2 = float(e2.train_batch(batch=batch))
+        l1 = float(e1.train_batch(batch=batch))
+        assert l2 == pytest.approx(l1, rel=1e-5)
+        p2 = jax.device_get(e2.state.params)
+        p1 = jax.device_get(e1.state.params)
+        flat2 = jax.tree_util.tree_leaves(p2)
+        flat1 = jax.tree_util.tree_leaves(p1)
+        for a, b in zip(flat2, flat1):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       atol=5e-5, rtol=0)
+
+
 def test_bench_gate_moe_drop_extraction():
     import importlib.util
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))

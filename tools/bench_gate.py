@@ -45,7 +45,11 @@ regressions to diff but defects to refuse. MoE rounds (a ``moe``
 section in TELEMETRY.json, or MOE_BENCH.json) gate the drop-fraction
 p95 on an ABSOLUTE rise beyond ``--moe-drop-rise`` (default 0.05) —
 dropped tokens are silently-skipped compute; pre-MoE rounds skip,
-never fail. A metric missing on either
+never fail. Multislice rounds (a ``multislice`` record in
+MULTISLICE_BENCH.json, or a TELEMETRY.json roofline ``comm_tiers``
+section) gate DCN bytes/step on a RELATIVE rise beyond ``--dcn-rise``
+(default 10%) — the slow tier is the scale-out ceiling; pre-multislice
+rounds skip, never fail. A metric missing on either
 side is skipped with a notice, never a failure — rounds recorded before
 this tool (or before the serving tier / health layer) existed have no
 such field, and the gate must not retroactively break them. Exit 0 =
@@ -136,6 +140,22 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             moe_drop = float(df["p95"])
         elif isinstance(df, (int, float)):
             moe_drop = float(df)
+    # Multislice shape: MULTISLICE_BENCH.json's `multislice` record, or
+    # a TELEMETRY.json roofline's `comm_tiers` section — the gated
+    # figure is DCN bytes/step (regression = a RISE: the slow tier is
+    # the scale-out ceiling, and a change that silently moves more
+    # bytes over DCN eats it). Pre-multislice rounds carry neither ->
+    # skipped, never failed.
+    dcn_bytes: Optional[float] = None
+    msl = doc.get("multislice")
+    if isinstance(msl, dict) and msl.get("available", True) and \
+            msl.get("dcn_bytes_per_step") is not None:
+        dcn_bytes = float(msl["dcn_bytes_per_step"])
+    elif isinstance(doc.get("roofline"), dict):
+        tiers = doc["roofline"].get("comm_tiers")
+        if isinstance(tiers, dict) and \
+                tiers.get("wire_bytes_dcn") is not None:
+            dcn_bytes = float(tiers["wire_bytes_dcn"])
     # Health-layer TELEMETRY.json shape: validated (new side only), not
     # diffed. Pre-health rounds carry no section -> None -> skipped.
     health: Optional[Dict[str, Any]] = None
@@ -156,7 +176,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
-            "moe_drop": moe_drop}
+            "moe_drop": moe_drop, "dcn_bytes": dcn_bytes}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -181,7 +201,7 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
          goodput_drop: float, serve_drop: float = 0.10,
          ttft_rise: float = 0.25, kernel_drop: float = 0.10,
          hbm_rise: float = 0.15, accept_floor: float = 0.05,
-         moe_drop_rise: float = 0.05) -> int:
+         moe_drop_rise: float = 0.05, dcn_rise: float = 0.10) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -327,6 +347,23 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"zero3 prefetch overlap: skipped (no zero3 record in "
               f"{', '.join(missing)})")
 
+    if old["dcn_bytes"] is not None and new["dcn_bytes"] is not None:
+        compared += 1
+        ceil = old["dcn_bytes"] * (1.0 + dcn_rise)
+        verdict = "OK" if new["dcn_bytes"] <= ceil else "REGRESSION"
+        print(f"multislice dcn bytes/step: {name_old}="
+              f"{old['dcn_bytes']:.4g}B -> "
+              f"{name_new}={new['dcn_bytes']:.4g}B "
+              f"(ceiling {ceil:.4g}B, +{dcn_rise:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-multislice rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["dcn_bytes"] is None]
+        print(f"multislice dcn bytes/step: skipped (no multislice "
+              f"record in {', '.join(missing)})")
+
     if old["moe_drop"] is not None and new["moe_drop"] is not None:
         compared += 1
         ceil = old["moe_drop"] + moe_drop_rise
@@ -403,6 +440,9 @@ def main(argv=None) -> int:
     ap.add_argument("--moe-drop-rise", type=float, default=0.05,
                     help="max tolerated ABSOLUTE rise of the MoE "
                          "drop-fraction p95 (default 0.05)")
+    ap.add_argument("--dcn-rise", type=float, default=0.10,
+                    help="max tolerated RELATIVE rise of multislice "
+                         "DCN bytes/step (default 0.10)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -419,7 +459,8 @@ def main(argv=None) -> int:
     try:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
                     args.serve_drop, args.ttft_rise, args.kernel_drop,
-                    args.hbm_rise, args.accept_floor, args.moe_drop_rise)
+                    args.hbm_rise, args.accept_floor, args.moe_drop_rise,
+                    args.dcn_rise)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -31,8 +31,19 @@ of the repo's central scaling claims:
   all-to-alls over the `expert` axis (the first non-synthetic producer
   of the family this parser has priced since PR 6), 4 per MoE layer per
   step (fwd pair + backward transposes), each (ep-1)/ep of the [E,C,H]
-  dispatch buffer; expert grads all-reduce within their expert group
+  dispatch buffer; now audited at ZeRO-2 on the FACTORED explicit grad
+  path: dense grads reduce-scatter over `data` + all-reduce their 1/dp
+  residual across expert groups (the old stage-2 declarative
+  regression — dense grads materializing unpartitioned — is CLOSED and
+  gated here), expert grads reduce-scatter within their expert group
   (data) only.
+- **multislice**: hierarchical ICI/DCN sync on the slices=2 x dp=4
+  mesh — in-slice reduce-scatter (groups of dp, inside the gas scan) +
+  ONE inter-slice all-reduce of the accumulated 1/dp residual (groups
+  of `slices`); compiled wire within 5% of the two-tier analytic model
+  on BOTH tiers, never a grad-sized collective spanning the slice axis,
+  and the `dcn_compression` wire format prices the DCN hop >= 8x
+  smaller while ICI bytes are unchanged.
 
 Usage: python tools/comm_audit.py [--out COMM_AUDIT.json]
 (tools/run_comm_audit.sh wraps this with the tier-1 env.)
@@ -429,8 +440,12 @@ def audit_ring_attention():
 def audit_moe():
     """MoE expert parallelism: the FIRST real producer of the
     all-to-all family this module's parser has priced synthetically
-    since PR 6. An 8-expert top-2 gpt2-tiny on the ep=4 x dp=2 mesh
-    (ZeRO-1, unrolled layers so every collective appears literally):
+    since PR 6. An 8-expert top-2 gpt2-tiny on the ep=4 x dp=2 mesh —
+    at ZeRO-2 on the FACTORED explicit grad path since the multislice
+    round (historically ZeRO-1: the stage-2 declarative lowering
+    regressed to all-reduce + slice for the (expert, data)-sharded
+    batch; the factored shard_map closed it, and this audit RECORDS the
+    closure):
 
     - dispatch + combine lower to REAL all-to-alls over the 4-member
       expert groups — 4 per MoE layer (fwd pair + their backward
@@ -438,12 +453,13 @@ def audit_moe():
     - compiled all-to-all wire within 5% of the analytic
       ``moe_alltoall_wire_model`` (exact, in fact: the buffer shape is
       static);
-    - expert-weight grads all-reduce over ``data`` WITHIN their expert
-      group only (groups never wider than dp) — experts are not
-      replicas;
+    - DENSE grads reduce-scatter over ``data`` (never materialize
+      unpartitioned at full size — the closed regression's signature);
+    - expert-weight grads sync over ``data`` WITHIN their expert group
+      only (groups never wider than dp) — experts are not replicas;
     - no collective gathers token buffers ACROSS expert groups (the
       all-to-all degenerating to all-gather; gathers over data are the
-      legal ZeRO-1 param pattern)."""
+      legal ZeRO param pattern)."""
     import dataclasses
     from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,
                                            gpt2_loss_fn)
@@ -463,7 +479,7 @@ def audit_moe():
         config={"train_batch_size": 32,
                 "train_micro_batch_size_per_gpu": 4,
                 "gradient_accumulation_steps": 1,
-                "zero_optimization": {"stage": 1},
+                "zero_optimization": {"stage": 2},
                 "optimizer": {"type": "Adam",
                               "params": {"lr": 1e-3, "fused": False}},
                 "moe": {"num_experts": E, "top_k": k,
@@ -493,6 +509,43 @@ def audit_moe():
     expert_gather = [o for o in audit.of_kind("all-gather")
                      if o.group_size > e.dp_size
                      and o.payload_bytes >= model["dispatch_buffer_bytes"]]
+    # The CLOSED stage-2 regression, audited: on the factored explicit
+    # path every scatterable grad leaf psum_scatters over `data` — the
+    # compiled reduce-scatter payload must equal the analytic
+    # scatterable total (dense leaves at full size + expert leaves at
+    # their 1/ep local size), and no DIVISIBLE dense leaf may appear as
+    # a full-size all-reduce (the regression's signature: the gradient
+    # materializing unpartitioned). Shard-size collisions are excluded
+    # from the AR check (losing coverage for that leaf, never CI).
+    from deepspeed_tpu.moe.sharding import is_expert_spec
+    from deepspeed_tpu.runtime.zero.partition import (_layer_dp,
+                                                      _leaf_spec,
+                                                      spec_dp_dim)
+    p_leaves = jax.tree_util.tree_leaves(jax.device_get(e.state.params))
+    spec_leaves = jax.tree_util.tree_structure(
+        e.state.params).flatten_up_to(e._param_specs)
+    rs_expect = 0
+    dense_full_div = set()
+    shardish = set()
+    for l, sp in zip(p_leaves, spec_leaves):
+        nbytes = int(np.prod(l.shape)) * 4
+        if is_expert_spec(sp):
+            local = nbytes // ep
+            layered = _layer_dp(sp, l.shape, e.dp_size, "data")
+            if spec_dp_dim(layered, "data") is not None:
+                rs_expect += local
+                shardish.add(local // e.dp_size)
+            continue
+        spec = _leaf_spec(l.shape, e.dp_size, "data")
+        if any(s is not None for s in spec):
+            rs_expect += nbytes
+            dense_full_div.add(nbytes)
+            shardish.add(nbytes // e.dp_size)
+    rs_ops = audit.of_kind("reduce-scatter")
+    rs_payload = sum(o.payload_bytes for o in rs_ops)
+    dense_regression_ar = [
+        o for o in audit.of_kind("all-reduce")
+        if o.payload_bytes in (dense_full_div - shardish)]
     checks = {
         "alltoall_pair_per_moe_layer": len(a2a) >= 2 * n_moe,
         "fwd_plus_bwd_alltoalls": len(a2a) == 4 * n_moe,
@@ -506,13 +559,25 @@ def audit_moe():
             0.05 * model["wire_bytes_per_step"],
         "no_expert_grad_allreduce_across_experts": not cross_expert_ar,
         "no_cross_group_token_gather": not expert_gather,
+        "grad_sync_resolves_explicit": e._grad_sync_mode == "explicit",
+        "stage2_dense_grads_reduce_scattered":
+            bool(rs_ops) and rs_payload == rs_expect,
+        "stage2_regression_closed_no_dense_fullsize_allreduce":
+            not dense_regression_ar,
     }
     return {
         "config": {"num_experts": E, "top_k": k, "capacity_factor": cf,
                    "ep": ep, "dp": e.dp_size,
                    "moe_layers": n_moe,
                    "tokens_per_device": tokens_per_device,
-                   "zero_stage": 1},
+                   "zero_stage": 2,
+                   "grad_sync": e._grad_sync_mode},
+        "regression_note": (
+            "historically audited at ZeRO-1: the stage-2 declarative "
+            "lowering regressed dense grads to all-reduce + slice on "
+            "the (expert, data) mesh; the factored explicit shard_map "
+            "path closed it (ROADMAP 4b) — the stage2_* checks gate "
+            "the closure"),
         "hlo": audit.summary(),
         "model": model,
         "compiled_alltoall_wire_bytes": compiled_wire,
@@ -522,6 +587,110 @@ def audit_moe():
              "num_groups": o.num_groups}
             for o in audit.of_kind("all-reduce")
             if o.payload_bytes in expert_bytes],
+        "checks": checks, "pass": all(checks.values()),
+    }
+
+
+def audit_multislice():
+    """Hierarchical ICI/DCN gradient sync on the slices=2 x dp=4 mesh
+    (ZeRO-2, gas=2 so the scan placement is audited). The tier-1 gate
+    of the multislice round:
+
+    - grads reduce-scatter IN-SLICE (groups of dp) INSIDE the gas scan;
+    - the inter-slice all-reduce (groups of `slices`) carries only the
+      accumulated 1/dp residual, ONCE per step (outside the scan) —
+      never a grad-sized flat collective spanning the slice axis;
+    - compiled wire within 5% of the two-tier analytic model on BOTH
+      tiers (classified by replica-group signature —
+      parallel/multislice.classify_two_tier);
+    - with ``dcn_compression``, the PRICED DCN bytes drop >= 8x while
+      the ICI figure is unchanged (the in-XLA emulation psums the
+      decompressed values; the wire format is packed sign bits + per-
+      chunk scales, like the onebit flagship's honesty note)."""
+    from deepspeed_tpu.parallel.multislice import two_tier_wire_summary
+
+    slices, gas = 2, 2
+    e = _engine({"zero_optimization": {"stage": 2},
+                 "mesh": {"slices": slices}}, gas=gas)
+    dp = e.dp_size
+    audit = _audit_train_step(e, gas=gas)
+    model = hlo_audit.grad_sync_wire_model(
+        jax.device_get(e.state.params), dp, slices=slices)
+    # min_payload 1: the toy tree's smallest DCN shards are 4 B — the
+    # 5% gate needs them counted; the only sub-64 B extras swept in are
+    # the scalar loss psums (a few bytes against a 636 B tier). Static
+    # HLO counts: in-loop collectives appear ONCE, so the ICI figure
+    # compares against the per-micro-step model term.
+    tiers = two_tier_wire_summary(audit.ops, slices, dp,
+                                  min_payload_bytes=1)
+    rs = audit.of_kind("reduce-scatter")
+    rs_payload = sum(o.payload_bytes for o in rs)
+    flat = [o for o in audit.ops
+            if o.kind in ("all-reduce", "reduce-scatter")
+            and o.payload_bytes >= model["scatterable_bytes"] // 8
+            and o.group_size > dp]
+    dcn_ars = [o for o in audit.of_kind("all-reduce")
+               if o.group_size == slices and o.payload_bytes >= 16]
+
+    # The compression variant prices the SAME program's DCN hop in the
+    # 1-bit wire format; the compiled ICI collectives must not change.
+    ec = _engine({"zero_optimization": {"stage": 2,
+                                        "dcn_compression": True},
+                  "mesh": {"slices": slices}}, gas=gas)
+    audit_c = _audit_train_step(ec, gas=gas)
+    tiers_c = two_tier_wire_summary(audit_c.ops, slices, dp,
+                                    min_payload_bytes=1)
+    model_c = hlo_audit.grad_sync_wire_model(
+        jax.device_get(ec.state.params), dp, slices=slices,
+        dcn_compression=True)
+
+    checks = {
+        "grads_reduce_scatter_in_slice": bool(rs) and all(
+            o.group_size == dp for o in rs),
+        "in_slice_scatter_inside_gas_scan": bool(rs) and all(
+            o.in_loop for o in rs),
+        "rs_payload_is_scatterable":
+            rs_payload == model["scatterable_bytes"],
+        "dcn_hop_once_outside_scan": bool(dcn_ars) and all(
+            not o.in_loop for o in dcn_ars),
+        "no_grad_sized_collective_spans_slice_axis": not flat,
+        # The ICI tier comparison covers the GRAD-SYNC reduce-scatters
+        # (what the model prices); the classified tier totals also
+        # carry ZeRO's legal param all-gather after the sharded update
+        # and are recorded below for the full picture.
+        "ici_wire_within_5pct_of_model": abs(
+            sum(o.wire_bytes for o in rs) - model["ici_wire_bytes"]) <= \
+            0.05 * model["ici_wire_bytes"],
+        "dcn_wire_within_5pct_of_model": abs(
+            tiers["dcn"] - model["dcn_wire_bytes"]) <= \
+            0.05 * model["dcn_wire_bytes"],
+        "compression_prices_dcn_8x_down":
+            model_c["dcn_wire_bytes"] >=
+            8 * model_c["dcn_wire_bytes_compressed"],
+        "compression_leaves_ici_unchanged":
+            tiers_c["ici"] == tiers["ici"],
+    }
+    return {
+        "config": {"slices": slices, "dp": dp, "gas": gas,
+                   "zero_stage": 2, "grad_sync": e._grad_sync_mode},
+        "hlo": audit.summary(),
+        "model": {k: v for k, v in model.items() if k != "moe"},
+        "compiled_two_tier_wire": tiers,
+        "compiled_two_tier_wire_compressed": tiers_c,
+        "compression": {
+            "dcn_wire_bytes_dense": model_c["dcn_wire_bytes"],
+            "dcn_wire_bytes_compressed":
+                model_c["dcn_wire_bytes_compressed"],
+            "ratio": round(model_c["dcn_wire_bytes"] /
+                           model_c["dcn_wire_bytes_compressed"], 2),
+        },
+        "hlo_note": "the DCN 'wire' figures here classify EMULATED "
+                    "collectives on the CPU mesh by replica-group "
+                    "signature — structural truth (which ops, what "
+                    "payloads, which groups), not measured DCN; the "
+                    "compression figures are the packed wire format "
+                    "(emulation psums decompressed values, like the "
+                    "onebit flagship)",
         "checks": checks, "pass": all(checks.values()),
     }
 
@@ -574,7 +743,8 @@ def main():
                      ("onebit", audit_onebit),
                      ("pipeline_1f1b", audit_1f1b),
                      ("ring_attention", audit_ring_attention),
-                     ("moe", audit_moe)]:
+                     ("moe", audit_moe),
+                     ("multislice", audit_multislice)]:
         print(f"[comm_audit] auditing {name} ...", flush=True)
         try:
             record["configs"][name] = fn()

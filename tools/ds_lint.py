@@ -21,9 +21,16 @@ Flagships (the engine modes whose compiled programs differ):
 - **offload** — ZeRO-Offload bucketed grad pass (host Adam)
 - **pipeline_1f1b** — compiled pp=2 interleaved pipeline ticks
 - **moe**    — expert-parallel MoE FFN (8 experts top-2, ep=4 x dp=2,
-  ZeRO-1): all-to-all dispatch/combine, expert weights born sharded
-  over the `expert` axis; collective_placement's expert check gates
+  ZeRO-2): all-to-all dispatch/combine, expert weights born sharded
+  over the `expert` axis; since the factored explicit grad path landed,
+  dense grads reduce-scatter over `data` (the old stage-2 declarative
+  regression, closed) and collective_placement's expert check gates
   that no expert grad all-reduces across the expert axis
+- **multislice** — hierarchical ICI/DCN gradient sync on the
+  slices=2 x dp=4 mesh (ZeRO-2, gas=2): grads reduce-scatter in-slice
+  INSIDE the gas scan, only the 1/dp residual all-reduces across
+  slices, and collective_placement's slice check gates that nothing
+  grad-sized spans the slice axis (a flat joint sync over DCN)
 - **serving** — the inference tier's paged compiled paths (gpt2-tiny,
   continuous batching over the block pool): group-batched chunked
   prefill, plain decode, the speculative verify step, and the
@@ -214,11 +221,13 @@ def build_pipeline_1f1b():
 
 def build_moe():
     # MoE expert parallelism: 8-expert top-2 gpt2-tiny on the ep=4 x
-    # dp=2 mesh, ZeRO-1 (sharded moments — dense grad sync is an honest
-    # all-reduce declaration; the stage-2 declarative lowering regresses
-    # on this backend for the (expert, data)-sharded batch and is
-    # audited in COMM_AUDIT.json instead of waived here). The passes
-    # gate: dispatch/combine stay real all-to-alls with no tree-scale
+    # dp=2 mesh, ZeRO-2. Historically this flagship ran ZeRO-1 because
+    # the stage-2 declarative lowering regressed to all-reduce + slice
+    # for the (expert, data)-sharded batch; the factored explicit grad
+    # path (shard_map over (expert, data), psum_scatter over data +
+    # cross-group all-reduce of the dense residual) closed that — the
+    # passes now gate the CLOSED state: dense grads reduce-scatter,
+    # dispatch/combine stay real all-to-alls with no tree-scale
     # materialization of expert state, and collective_placement's
     # expert check proves no expert grad ever all-reduces ACROSS the
     # expert axis (its seeded violation lives in tests/test_moe.py).
@@ -239,7 +248,7 @@ def build_moe():
     ds_cfg = {"train_batch_size": 32,
               "train_micro_batch_size_per_gpu": 4,
               "gradient_accumulation_steps": 1,
-              "zero_optimization": {"stage": 1},
+              "zero_optimization": {"stage": 2},
               "gradient_clipping": 1.0,
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
               "moe": {"num_experts": E, "top_k": 2,
@@ -297,6 +306,17 @@ def build_serving():
     return engine
 
 
+def build_multislice():
+    # Multi-slice hierarchical sync: slices=2 x dp=4 ZeRO-2 with gas=2
+    # so the audited program carries the full schedule — in-slice
+    # psum_scatter INSIDE the accumulation scan, one inter-slice
+    # all-reduce of the accumulated 1/dp residual outside it.
+    # collective_placement's slice check (grad-spans-dcn) gates that
+    # nothing grad-sized crosses the slice axis.
+    return _engine("multislice", {"zero_optimization": {"stage": 2},
+                                  "mesh": {"slices": 2}}, gas=2)
+
+
 FLAGSHIPS = {
     "zero1": build_zero1,
     "zero2": build_zero2,
@@ -306,6 +326,7 @@ FLAGSHIPS = {
     "pipeline_1f1b": build_pipeline_1f1b,
     "serving": build_serving,
     "moe": build_moe,
+    "multislice": build_multislice,
 }
 
 

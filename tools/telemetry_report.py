@@ -326,7 +326,8 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
                 continue
             paths[name] = {k: p.get(k) for k in
                            ("bound", "floor_ms", "t_compute_ms", "t_hbm_ms",
-                            "t_comm_ms", "scan_scale", "available")
+                            "t_comm_ms", "t_dcn_ms", "scan_scale",
+                            "available")
                            if k in p}
         roofline.update({
             "chip": chip,
@@ -337,6 +338,22 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "flops_per_step": cm_step.get("flops_per_step"),
             "missing_paths": cm_step.get("missing_paths"),
         })
+        # Two-tier interconnect verdict (multislice runs): the wire
+        # bytes each tier moves per step (telemetry meta) and which
+        # tier binds comm — a step can be DCN-bound while ICI idles,
+        # and the fused t_comm figure alone would hide it.
+        if int(meta.get("slices") or 1) > 1:
+            t_ici = sum((p.get("t_comm_ms") or 0.0) for p in paths.values())
+            t_dcn = sum((p.get("t_dcn_ms") or 0.0) for p in paths.values())
+            roofline["comm_tiers"] = {
+                "slices": int(meta["slices"]),
+                "wire_bytes_ici": meta.get("wire_bytes_ici"),
+                "wire_bytes_dcn": meta.get("wire_bytes_dcn"),
+                "dcn_compression": bool(meta.get("dcn_compression")),
+                "t_ici_ms": round(t_ici, 6),
+                "t_dcn_ms": round(t_dcn, 6),
+                "comm_bound_tier": "dcn" if t_dcn > t_ici else "ici",
+            }
         # Optimizer-apply analytic pricing (one-pass vs two-pass HBM
         # bytes) rides the cost_model record when the engine runs the
         # fused apply family.
