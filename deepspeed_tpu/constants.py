@@ -578,3 +578,37 @@ CHECKPOINT = "checkpoint"
 CHECKPOINT_TAG_VALIDATION = "tag_validation"
 CHECKPOINT_TAG_VALIDATION_DEFAULT = "Warn"
 CHECKPOINT_TAG_VALIDATION_MODES = ["Warn", "Ignore", "Fail"]
+# Async checkpointing (runtime/async_ckpt.py): save_checkpoint() runs a
+# fast in-step-window SNAPSHOT (one batched device_get into host
+# buffers) and hands serialization + the two-phase atomic commit to a
+# background writer thread. Sync and async paths share the commit
+# byte-for-byte; both flip `latest` via tmp + os.replace.
+CHECKPOINT_ASYNC = "async"
+CHECKPOINT_ASYNC_DEFAULT = False
+# Auto-save cadence: > 0 saves a checkpoint (tag global_stepN) into
+# `save_dir` every N completed steps from inside train_batch.
+CHECKPOINT_SNAPSHOT_EVERY = "snapshot_every"
+CHECKPOINT_SNAPSHOT_EVERY_DEFAULT = 0
+# Directory for auto-saves and the SIGTERM final save. Required when
+# snapshot_every > 0; enables the preemption handler when set.
+CHECKPOINT_SAVE_DIR = "save_dir"
+CHECKPOINT_SAVE_DIR_DEFAULT = ""
+# SIGTERM handler (chains with the flight recorder's): requests a final
+# snapshot+commit when one isn't already in flight, then re-raises so
+# the exit code stays honest. Effective only with a save_dir.
+CHECKPOINT_PREEMPT_SAVE = "preempt_save"
+CHECKPOINT_PREEMPT_SAVE_DEFAULT = True
+# Writer knobs: max snapshots allowed in the writer queue before the
+# NEXT save blocks (each pending snapshot is a full host copy of the
+# state — this bounds host memory; the blocking wait is exposed and
+# priced into the goodput checkpoint bucket, honestly), and the
+# hang-watchdog timeout guarding each background write.
+CHECKPOINT_MAX_PENDING = "max_pending_snapshots"
+CHECKPOINT_MAX_PENDING_DEFAULT = 1
+CHECKPOINT_WRITER_TIMEOUT_S = "writer_timeout_s"
+CHECKPOINT_WRITER_TIMEOUT_S_DEFAULT = 300.0
+# fsync blobs + dirs at commit: required for durability across MACHINE
+# crashes; a plain process kill (preemption) never needs it, and the
+# CPU-mesh test tier keeps it off for speed.
+CHECKPOINT_FSYNC = "fsync"
+CHECKPOINT_FSYNC_DEFAULT = False

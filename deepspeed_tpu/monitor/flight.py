@@ -43,6 +43,31 @@ from ..utils.logging import logger
 _SIGNALS = ("SIGTERM", "SIGINT")
 
 
+def dispatch_prev_handler(prev, signum, frame, own_handler) -> None:
+    """Continue a chained signal after our handler ran: call a callable
+    prior handler, or re-raise under the default disposition so the
+    process reports the true termination signal (None / C-installed
+    handlers are opaque — dying by the signal is the only honest
+    continuation; SIG_IGN stays ignored). Shared by the flight recorder
+    and the checkpoint PreemptSaver (runtime/async_ckpt.py) so every
+    member of a handler chain re-raises identically."""
+    if callable(prev) and prev is not own_handler:
+        prev(signum, frame)
+    elif prev in (signal.SIG_DFL, None):
+        # If the process disposition still points at the caller (a chain
+        # restored it), force the default first — otherwise the re-raise
+        # would re-enter it forever.
+        try:
+            if signal.getsignal(signum) == own_handler:
+                signal.signal(signum, signal.SIG_DFL)
+        except (ValueError, OSError):
+            pass
+        try:
+            os.kill(os.getpid(), signum)
+        except Exception:
+            sys.exit(128 + int(signum))
+
+
 class FlightRecorder:
     """Host-side black box for one Telemetry instance."""
 
@@ -189,26 +214,7 @@ class FlightRecorder:
         self._dispatch_prev(prev, signum, frame)
 
     def _dispatch_prev(self, prev, signum, frame) -> None:
-        if callable(prev) and prev is not self._on_signal:
-            prev(signum, frame)
-        elif prev in (signal.SIG_DFL, None):
-            # Re-raise under the default disposition so the process
-            # reports the true termination signal to its parent. A None
-            # prior handler (installed from C, not Python) is opaque —
-            # dying by the signal is the only honest continuation.
-            # (SIG_IGN falls through: ignoring stays ignoring.) If the
-            # process disposition still points at THIS handler (a chain
-            # restored it), force the default first — otherwise the
-            # re-raise would re-enter us forever.
-            try:
-                if signal.getsignal(signum) == self._on_signal:
-                    signal.signal(signum, signal.SIG_DFL)
-            except (ValueError, OSError):
-                pass
-            try:
-                os.kill(os.getpid(), signum)
-            except Exception:
-                sys.exit(128 + int(signum))
+        dispatch_prev_handler(prev, signum, frame, self._on_signal)
 
     def note_signal(self, name: str) -> None:
         """Snapshot the signal-time state BEFORE any drain runs: the
@@ -305,4 +311,4 @@ class FlightRecorder:
             return None
 
 
-__all__ = ["FlightRecorder"]
+__all__ = ["FlightRecorder", "dispatch_prev_handler"]

@@ -22,7 +22,14 @@ drain:
   discarded), minus any stall/compile wall measured inside those steps
   — that time is reattributed to its own bucket (a step can both
   cold-compile and overflow; the seconds are counted once).
-- ``checkpoint``   — save/load wall (outermost checkpoint span only).
+- ``checkpoint``   — EXPOSED checkpoint wall (outermost checkpoint span
+  only): sync save/load wall, or under async checkpointing the
+  snapshot fetch + any blocking wait on the writer. Two sub-figures
+  ride along without joining the bucket sum: ``checkpoint_snapshot_s``
+  (the snapshot-phase subset of the exposed bucket) and
+  ``checkpoint_write_bg_s`` (the BACKGROUND writer's wall — measured on
+  its own thread, overlapping useful compute, so charging it against
+  the window would double-count the same seconds).
 - ``offload_exposed`` — ZeRO-Offload host time NOT hidden behind device
   work (step wall minus the device-only phase).
 - ``other``        — the residual: window wall minus everything above
@@ -38,6 +45,7 @@ opens at the same instant, so no second is silently outside all windows.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
@@ -57,24 +65,50 @@ class GoodputLedger:
         self._noted: Dict[str, float] = {"data_stall": 0.0,
                                          "recompile": 0.0,
                                          "checkpoint": 0.0}
+        # Sub-figures: named subsets of a bucket (checkpoint_snapshot
+        # within checkpoint). Reported per window, never summed into the
+        # bucket total a second time.
+        self._sub: Dict[str, float] = {}
+        # Background seconds measured on other threads (the checkpoint
+        # writer): overlap the window, reported but not charged.
+        self._bg: Dict[str, float] = {}
+        self._bg_lock = threading.Lock()
         self.windows_closed = 0
         self.totals: Dict[str, float] = {b: 0.0 for b in BUCKETS}
+        self.sub_totals: Dict[str, float] = {}
+        self.bg_totals: Dict[str, float] = {}
         self.total_window_s = 0.0
 
     # ------------------------------------------------------------------ #
     # In-window accumulation (hot-path safe: float adds)
     # ------------------------------------------------------------------ #
-    def note(self, bucket: str, seconds: float) -> None:
+    def note(self, bucket: str, seconds: float,
+             sub: Optional[str] = None) -> None:
         """Record directly-measured seconds for ``data_stall`` /
-        ``recompile`` / ``checkpoint`` as they happen."""
+        ``recompile`` / ``checkpoint`` as they happen. ``sub`` names a
+        reported-only subset of the bucket (``checkpoint_snapshot``)."""
         if seconds > 0.0:
             self._noted[bucket] = self._noted.get(bucket, 0.0) + seconds
+            if sub is not None:
+                self._sub[sub] = self._sub.get(sub, 0.0) + seconds
+
+    def note_background(self, key: str, seconds: float) -> None:
+        """Record seconds measured on a BACKGROUND thread (the async
+        checkpoint writer). Reported as ``<key>_bg_s`` per window,
+        excluded from the bucket sum — those seconds overlap the window
+        and charging them would double-count the wall."""
+        if seconds > 0.0:
+            with self._bg_lock:
+                self._bg[key] = self._bg.get(key, 0.0) + seconds
 
     def has_pending(self) -> bool:
         """True when directly-measured seconds await settlement — e.g. a
         checkpoint saved after the last report boundary. close() checks
         this so trailing attributed time is never silently dropped."""
-        return any(v > 0.0 for v in self._noted.values())
+        if any(v > 0.0 for v in self._noted.values()):
+            return True
+        with self._bg_lock:
+            return any(v > 0.0 for v in self._bg.values())
 
     def peek(self, now: Optional[float] = None) -> Dict[str, Any]:
         """Non-destructive view of the OPEN window (the flight
@@ -83,9 +117,12 @@ class GoodputLedger:
         Settlement math (residual, consistency) only happens at
         close_window — this is the raw evidence, not a verdict."""
         now = self._clock() if now is None else now
+        with self._bg_lock:
+            bg = {k: round(v, 6) for k, v in self._bg.items()}
         return {
             "open_window_s": round(max(0.0, now - self.window_t0), 6),
             "noted_s": {k: round(v, 6) for k, v in self._noted.items()},
+            "background_s": bg,
             "windows_closed": self.windows_closed,
         }
 
@@ -130,17 +167,31 @@ class GoodputLedger:
         other = window_s - sum(buckets.values())
         buckets["other"] = other
 
+        sub = self._sub
+        self._sub = {}
+        with self._bg_lock:
+            bg = self._bg
+            self._bg = {}
         self._noted = {"data_stall": 0.0, "recompile": 0.0,
                        "checkpoint": 0.0}
         self.window_t0 = now
         self.windows_closed += 1
         for b in BUCKETS:
             self.totals[b] += buckets[b]
+        for k, v in sub.items():
+            self.sub_totals[k] = self.sub_totals.get(k, 0.0) + v
+        for k, v in bg.items():
+            self.bg_totals[k] = self.bg_totals.get(k, 0.0) + v
         self.total_window_s += window_s
 
         out: Dict[str, Any] = {"window_s": round(window_s, 6),
                                "steps": len(step_list)}
         out.update({f"{b}_s": round(buckets[b], 6) for b in BUCKETS})
+        # Reported-only figures: subsets of a bucket and background
+        # (overlapped) seconds — OUTSIDE the sum the accounted-fraction
+        # check covers, by design.
+        out.update({f"{k}_s": round(v, 6) for k, v in sub.items()})
+        out.update({f"{k}_bg_s": round(v, 6) for k, v in bg.items()})
         # Sum check the acceptance gate reads: measured buckets + residual
         # vs window wall. A healthy run keeps overflow and the residual
         # non-negative; double-attribution shows up as either < 0.
@@ -159,8 +210,18 @@ class GoodputLedger:
             "total_window_s": round(total, 6),
         }
         out.update({f"{b}_s": round(self.totals[b], 6) for b in BUCKETS})
+        out.update({f"{k}_s": round(v, 6)
+                    for k, v in self.sub_totals.items()})
+        out.update({f"{k}_bg_s": round(v, 6)
+                    for k, v in self.bg_totals.items()})
         out["goodput_fraction"] = round(
             self.totals["useful_compute"] / total, 6) if total > 0 else 0.0
+        if total > 0:
+            # The headline the resilience gate reads: how much of the
+            # wall the run actually PAID for checkpointing (background
+            # write wall is excluded — it overlapped).
+            out["checkpoint_exposed_share"] = round(
+                self.totals["checkpoint"] / total, 6)
         return out
 
 

@@ -315,6 +315,13 @@ class HangWatchdog:
         self._last_beat = time.perf_counter()
         self._armed = True
 
+    def disarm(self) -> None:
+        """Stand down until the next beat(). For bounded-duration guards
+        (the async checkpoint writer arms at write start and disarms at
+        completion) where silence between work items is legitimate, not
+        a hang."""
+        self._armed = False
+
     # -------------------------- thread ------------------------------- #
     def start(self) -> None:
         if self._thread is not None:

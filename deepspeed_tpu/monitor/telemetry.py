@@ -307,15 +307,20 @@ class Telemetry:
         """Host-span context manager. Feeds the trace writer (when a
         trace_path is set) and, for ``checkpoint_*`` spans, the goodput
         ledger's checkpoint bucket — outermost span only, so the
-        pipeline engine's nested per-layer spans don't double-count."""
+        pipeline engine's nested per-layer spans don't double-count.
+        The async save path's ``checkpoint_snapshot`` span additionally
+        files its wall under the ledger's ``checkpoint_snapshot``
+        sub-figure — the exposed part of an async save."""
         bucket = "checkpoint" if name.startswith("checkpoint_") else None
         if self.tracer is None and (bucket is None or self.ledger is None):
             return nullcontext()
-        return self._span_ctx(name, bucket, args)
+        sub = "checkpoint_snapshot" if name == "checkpoint_snapshot" \
+            else None
+        return self._span_ctx(name, bucket, args, sub=sub)
 
     @contextmanager
     def _span_ctx(self, name: str, bucket: Optional[str],
-                  args: Dict[str, Any]):
+                  args: Dict[str, Any], sub: Optional[str] = None):
         outermost = False
         if bucket is not None and self.ledger is not None:
             outermost = self._ckpt_depth == 0
@@ -331,7 +336,15 @@ class Telemetry:
             if bucket is not None and self.ledger is not None:
                 self._ckpt_depth -= 1
                 if outermost:
-                    self.ledger.note(bucket, time.perf_counter() - t0)
+                    self.ledger.note(bucket, time.perf_counter() - t0,
+                                     sub=sub)
+
+    def note_checkpoint_write_bg(self, seconds: float) -> None:
+        """Background checkpoint-writer wall (called from the writer
+        thread): reported in the ledger's overlapped ``checkpoint_write``
+        figure, never charged against the window."""
+        if self.ledger is not None:
+            self.ledger.note_background("checkpoint_write", seconds)
 
     def add_span(self, name: str, t_start: float, dur_s: float,
                  args: Optional[Dict[str, Any]] = None) -> None:

@@ -151,6 +151,70 @@ class TelemetryHealthConfig:
                 f"positive int, got {self.flight_window!r}")
 
 
+class CheckpointConfig:
+    """The ``checkpoint`` block (runtime/async_ckpt.py + the engine's
+    save/load paths): async snapshot-to-host saving, the auto-save
+    cadence, and the preemption (SIGTERM) final-save handler. Tag
+    validation stays on the DeepSpeedConfig top level for
+    compatibility."""
+
+    def __init__(self, param_dict: Optional[Dict[str, Any]] = None):
+        d = (param_dict or {}).get(C.CHECKPOINT, {})
+        get = config_utils.get_scalar_param
+        self.async_save = get(d, C.CHECKPOINT_ASYNC,
+                              C.CHECKPOINT_ASYNC_DEFAULT)
+        self.snapshot_every = get(d, C.CHECKPOINT_SNAPSHOT_EVERY,
+                                  C.CHECKPOINT_SNAPSHOT_EVERY_DEFAULT)
+        self.save_dir = get(d, C.CHECKPOINT_SAVE_DIR,
+                            C.CHECKPOINT_SAVE_DIR_DEFAULT)
+        self.preempt_save = get(d, C.CHECKPOINT_PREEMPT_SAVE,
+                                C.CHECKPOINT_PREEMPT_SAVE_DEFAULT)
+        self.max_pending_snapshots = get(d, C.CHECKPOINT_MAX_PENDING,
+                                         C.CHECKPOINT_MAX_PENDING_DEFAULT)
+        self.writer_timeout_s = get(d, C.CHECKPOINT_WRITER_TIMEOUT_S,
+                                    C.CHECKPOINT_WRITER_TIMEOUT_S_DEFAULT)
+        self.fsync = get(d, C.CHECKPOINT_FSYNC, C.CHECKPOINT_FSYNC_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        blk = C.CHECKPOINT
+        for name, v in ((C.CHECKPOINT_ASYNC, self.async_save),
+                        (C.CHECKPOINT_PREEMPT_SAVE, self.preempt_save),
+                        (C.CHECKPOINT_FSYNC, self.fsync)):
+            if not isinstance(v, bool):
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a bool, got {v!r}")
+        if not isinstance(self.snapshot_every, int) or \
+                isinstance(self.snapshot_every, bool) or \
+                self.snapshot_every < 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.CHECKPOINT_SNAPSHOT_EVERY} must be a "
+                f"non-negative int (0 = no auto-save), got "
+                f"{self.snapshot_every!r}")
+        if not isinstance(self.save_dir, str):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.CHECKPOINT_SAVE_DIR} must be a string path, "
+                f"got {self.save_dir!r}")
+        if self.snapshot_every > 0 and not self.save_dir:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.CHECKPOINT_SNAPSHOT_EVERY} > 0 needs "
+                f"{blk}.{C.CHECKPOINT_SAVE_DIR}: auto-saves have to land "
+                "somewhere")
+        if not isinstance(self.max_pending_snapshots, int) or \
+                isinstance(self.max_pending_snapshots, bool) or \
+                self.max_pending_snapshots < 1:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.CHECKPOINT_MAX_PENDING} must be an int >= 1 "
+                f"(each pending snapshot is a full host state copy), got "
+                f"{self.max_pending_snapshots!r}")
+        if not isinstance(self.writer_timeout_s, (int, float)) or \
+                isinstance(self.writer_timeout_s, bool) or \
+                self.writer_timeout_s <= 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.CHECKPOINT_WRITER_TIMEOUT_S} must be a "
+                f"positive number, got {self.writer_timeout_s!r}")
+
+
 class TelemetryConfig:
     """The ``telemetry`` block (monitor/ subsystem).
 
@@ -602,6 +666,7 @@ class DeepSpeedConfig:
             d.get(C.SPARSE_ATTENTION))
 
         ckpt = d.get(C.CHECKPOINT, {})
+        self.checkpoint_config = CheckpointConfig(d)
         self.checkpoint_tag_validation_mode = get(
             ckpt, C.CHECKPOINT_TAG_VALIDATION, C.CHECKPOINT_TAG_VALIDATION_DEFAULT)
         if isinstance(self.checkpoint_tag_validation_mode, str):

@@ -42,6 +42,9 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .async_ckpt import (AsyncCheckpointer, CheckpointSnapshot,
+                         LATEST_FILE, META_FILE, PreemptSaver,
+                         commit_snapshot, crash_point, is_complete)
 from .config import DeepSpeedConfig
 from .dataloader import DeepSpeedDataLoader, RepeatingLoader
 from .fp16.loss_scaler import (LossScaleState, make_loss_scale_state,
@@ -70,7 +73,6 @@ MODEL_FILE = "mp_rank_00_model_states.msgpack"
 MODEL_FILE_FMT = "mp_rank_{:02d}_model_states.msgpack"
 OPTIM_FILE_FMT = "zero_pp_rank_0_mp_rank_00_optim_states.msgpack"
 OPTIM_SHARD_FMT = "zero_pp_rank_{}_mp_rank_00_optim_states.msgpack"
-LATEST_FILE = "latest"
 
 
 def _spec_axis(sharding, axis_name: str):
@@ -740,6 +742,38 @@ class DeepSpeedEngine:
             self.telemetry.set_tap_spec(TapSpec.from_tree(
                 self.state.params))
             self._health_tap_fn = leaf_sq_taps
+
+        # Async / preemption-safe checkpointing (runtime/async_ckpt.py):
+        # the writer thread, the auto-save cadence, and the SIGTERM
+        # final-save handler. All inert unless the `checkpoint` config
+        # block opts in.
+        ckcfg = self.config.checkpoint_config
+        self._ckpt_dir = ckcfg.save_dir
+        self._ckpt_every = int(ckcfg.snapshot_every)
+        self._ckpt_max_pending = int(ckcfg.max_pending_snapshots)
+        self._ckpt_writer_timeout = float(ckcfg.writer_timeout_s)
+        self._ckpt_fsync = bool(ckcfg.fsync)
+        self._last_saved_step = -1
+        self._async_ckpt = None
+        self._preempt_saver = None
+        if ckcfg.async_save:
+            self._async_ckpt = AsyncCheckpointer(
+                telemetry=self.telemetry,
+                writer_timeout_s=self._ckpt_writer_timeout,
+                dump_dir=self.config.telemetry_config.output_path
+                or "./runs")
+        if self._ckpt_dir and ckcfg.preempt_save:
+            # Installed AFTER Telemetry built its flight recorder: on
+            # SIGTERM this handler runs FIRST (last installed wins),
+            # commits the final checkpoint, then chains to the flight
+            # recorder's handler — which persists FLIGHT.json and
+            # re-raises so the exit code stays honest.
+            self._preempt_saver = PreemptSaver(self, self._ckpt_dir)
+            self._preempt_saver.install()
+        if ckcfg.async_save or self._ckpt_every > 0:
+            self.telemetry.meta.setdefault("checkpoint", {
+                "async": bool(ckcfg.async_save),
+                "snapshot_every": self._ckpt_every})
 
         log_dist(f"DeepSpeedEngine initialized: dp={self.dp_size}, "
                  f"dtype={self.compute_dtype.__name__}, "
@@ -2936,7 +2970,18 @@ class DeepSpeedEngine:
         self.tput_timer.stop()
         self._record_telemetry(metrics, t_wall0, t_dispatch)
         self._maybe_log(metrics)
+        self._maybe_auto_save()
         return metrics["loss"]
+
+    def _maybe_auto_save(self) -> None:
+        """Auto-save (checkpoint.snapshot_every): tag global_stepN into
+        the configured save_dir — the resume anchor the crash/kill
+        harness (tools/crashkill.py) loads from. Shared by every
+        optimizer-step boundary: train_batch AND the
+        forward/backward/step trio honor the same cadence."""
+        if self._ckpt_every > 0 and \
+                self.global_steps % self._ckpt_every == 0:
+            self.save_checkpoint(self._ckpt_dir)
 
     # Alias matching common JAX naming.
     train_step = train_batch
@@ -3437,6 +3482,7 @@ class DeepSpeedEngine:
         self.global_samples += self.train_batch_size()
         self._record_telemetry(metrics, t0, t_apply)
         self._maybe_log(metrics)
+        self._maybe_auto_save()
 
     def _build_grad_paths(self):
         gas = self.gradient_accumulation_steps()
@@ -3574,42 +3620,117 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                         client_state: Optional[Dict[str, Any]] = None,
                         save_latest: bool = True) -> bool:
-        """Telemetry-spanned entry; see ``_save_checkpoint``."""
+        """Save a checkpoint. With ``checkpoint.async`` the call returns
+        after the in-step-window SNAPSHOT (one batched device fetch) and
+        a background thread serializes + commits; otherwise the whole
+        save runs inline. Both routes share the snapshot builder and the
+        two-phase atomic commit (runtime/async_ckpt.py), so the written
+        artifact is byte-identical either way."""
+        if self._async_ckpt is not None:
+            return self._save_checkpoint_async(save_dir, tag, client_state,
+                                               save_latest)
         with self.telemetry.span("checkpoint_save",
                                  tag=str(tag) if tag is not None else "auto"):
             return self._save_checkpoint(save_dir, tag, client_state,
                                          save_latest)
 
+    def _save_checkpoint_async(self, save_dir: str, tag: Optional[str],
+                               client_state: Optional[Dict[str, Any]],
+                               save_latest: bool) -> bool:
+        """Async save: the exposed cost is the ``checkpoint_snapshot``
+        span below (snapshot fetch + any blocking wait for writer-queue
+        room); serialization and the commit happen on the writer thread
+        and are priced into the ledger's background bucket."""
+        err = self._async_ckpt.last_error
+        if err is not None:
+            # Surface a failed background write on the NEXT save, where
+            # a caller can react — not silently in a daemon thread.
+            self._async_ckpt.last_error = None
+            raise RuntimeError(
+                "a previous background checkpoint write failed "
+                f"({type(err).__name__}: {err}); the checkpoint it was "
+                "writing is lost (latest still names the prior one)") \
+                from err
+        with self.telemetry.span(
+                "checkpoint_snapshot",
+                tag=str(tag) if tag is not None else "auto"):
+            # Bound host memory: each pending snapshot is a full host
+            # copy of the state. Waiting here is exposed wall and lands
+            # in the checkpoint bucket — honest accounting of a writer
+            # that cannot keep up with snapshot_every. A writer still
+            # wedged after writer_timeout_s fails the save LOUDLY:
+            # queueing another full-state copy would break the
+            # max_pending_snapshots bound, and the guard watchdog's
+            # stack dump already names what it is stuck on.
+            if not self._async_ckpt.wait_below(
+                    self._ckpt_max_pending,
+                    timeout=self._ckpt_writer_timeout):
+                raise RuntimeError(
+                    "checkpoint writer still busy after "
+                    f"{self._ckpt_writer_timeout:.0f}s — refusing to "
+                    "queue another full-state host snapshot past "
+                    f"max_pending_snapshots={self._ckpt_max_pending} "
+                    "(see the writer watchdog's stack dump)")
+            snap = self._snapshot_checkpoint(save_dir, tag, client_state,
+                                             save_latest)
+            crash_point("after_snapshot")
+            self._async_ckpt.submit(snap)
+        self._note_saved(save_dir, save_latest)
+        log_dist(f"checkpoint snapshot {snap.path} taken "
+                 "(background write queued)", ranks=[0])
+        return True
+
     def _save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
                          client_state: Optional[Dict[str, Any]] = None,
                          save_latest: bool = True) -> bool:
-        """Save under ``save_dir/tag/`` with the reference's sharded layout
-        (engine.py:1472-1572, §3.5):
+        """Synchronous save: snapshot + inline commit."""
+        snap = self._snapshot_checkpoint(save_dir, tag, client_state,
+                                         save_latest)
+        path = commit_snapshot(snap)
+        self._note_saved(save_dir, save_latest)
+        log_dist(f"saved checkpoint {path}", ranks=[0])
+        return True
+
+    def _note_saved(self, save_dir: str, save_latest: bool) -> None:
+        """Track the last step whose state reached the AUTO-SAVE dir's
+        ``latest`` — the preemption handler's dedup key. Saves into other
+        dirs (or without the latest flip) don't count: a final SIGTERM
+        save must still land in ``checkpoint.save_dir``."""
+        if save_latest and self._ckpt_dir and \
+                os.path.abspath(save_dir) == os.path.abspath(self._ckpt_dir):
+            self._last_saved_step = self.global_steps
+
+    def _snapshot_checkpoint(self, save_dir: str, tag: Optional[str],
+                             client_state: Optional[Dict[str, Any]],
+                             save_latest: bool) -> CheckpointSnapshot:
+        """Capture the engine state into a host-side CheckpointSnapshot
+        with the reference's sharded layout (engine.py:1472-1572, §3.5):
 
         - ``mp_rank_XX_model_states.msgpack`` — model params, one file per
           TP rank when mp > 1 (each holds only that rank's slice).
         - ``zero_pp_rank_D_mp_rank_00_optim_states.msgpack`` — one file per
           dp rank with that rank's ZeRO shard of the optimizer state; no
-          host ever materializes the full unsharded moments.
-        - ``latest`` pointer + ``engine_meta.json`` (counters + shard map).
+          host ever materializes the full unsharded moments. When
+          multislice DCN compression is live, the error-feedback buffers
+          ride these files under ``dcnN`` keys, sharded the same way.
+        - ``latest`` pointer + ``engine_meta.json`` (counters + shard map;
+          the meta file doubles as the commit's completeness seal).
 
-        Load re-assembles full arrays from the shards and re-partitions for
-        the CURRENT mesh, so dp-resize-on-load (stage1.py:848-1106 elastic
-        checkpoints) works across any dp sizes.
+        The device fetch is ONE batched ``jax.device_get`` over every
+        leaf the checkpoint needs — the telemetry drain's batched-fetch
+        discipline (fence-asserted in tier-1); serialization is deferred
+        to lazy blob builders so the async writer pays it, not the step
+        window. Load re-assembles full arrays from the shards and
+        re-partitions for the CURRENT mesh, so dp-resize-on-load
+        (stage1.py:848-1106 elastic checkpoints) works across any dp
+        sizes.
         """
         if tag is None:
             tag = f"global_step{self.global_steps}"
         self._checkpoint_tag_validation(tag)
-        path = os.path.join(save_dir, str(tag))
-        os.makedirs(path, exist_ok=True)
-
-        # Host counter may lag the device value between log boundaries —
-        # refresh BEFORE meta is built so the sidecar records the truth.
-        if self._offload is None:
-            self.skipped_steps = int(jax.device_get(self.state.skipped_steps))
         # Non-array metadata goes in a JSON sidecar: msgpack restore is
         # target-structured and would drop arbitrary client_state shapes.
-        meta = {
+        meta: Dict[str, Any] = {
             "global_steps": self.global_steps,
             "global_samples": self.global_samples,
             "skipped_steps": self.skipped_steps,
@@ -3625,30 +3746,107 @@ class DeepSpeedEngine:
             # SIZE, so a silent restore would scramble moments across
             # leaves; the load path refuses them instead.
             meta["fused_moment_layout"] = 2
-        if self.lr_scheduler is not None and hasattr(self.lr_scheduler, "state_dict"):
+        if self.lr_scheduler is not None and \
+                hasattr(self.lr_scheduler, "state_dict"):
             meta["lr_scheduler"] = self.lr_scheduler.state_dict()
 
+        blobs: List[Any] = []
         if self._offload is not None:
-            # Host masters ARE canonical; host-resident state saves whole.
-            model_blob = {"module": jax.tree_util.tree_map(
-                np.asarray, self._offload.master_tree())}
-            if jax.process_index() == 0:
-                with open(os.path.join(path, MODEL_FILE), "wb") as f:
-                    f.write(flax_serialization.to_bytes(model_blob))
-                with open(os.path.join(path, OPTIM_FILE_FMT), "wb") as f:
-                    f.write(flax_serialization.to_bytes(
-                        {"offload": self._offload.state_dict()}))
+            # Host masters ARE canonical; host-resident state saves
+            # whole. COPY the arrays: the background writer serializes
+            # this instant's values while the next steps mutate the
+            # buffers in place.
+            def _host_copy(x):
+                return np.array(x, copy=True) if isinstance(
+                    x, np.ndarray) else np.asarray(x)
+            host_params = jax.tree_util.tree_map(
+                _host_copy, self._offload.master_tree())
+            off_state = jax.tree_util.tree_map(
+                lambda x: np.array(x, copy=True)
+                if isinstance(x, np.ndarray) else x,
+                self._offload.state_dict())
+            blobs.append((MODEL_FILE,
+                          lambda hp=host_params:
+                          flax_serialization.to_bytes({"module": hp})))
+            blobs.append((OPTIM_FILE_FMT,
+                          lambda st=off_state:
+                          flax_serialization.to_bytes({"offload": st})))
         else:
-            self._save_model_states(path, meta)
-            self._save_optim_shards(path, meta)
+            # THE batched fetch: every device leaf the checkpoint needs,
+            # in one device_get (params + moments + scalars + DCN error
+            # feedback). The host counter refresh rides it too — the
+            # old separate skipped_steps sync is gone.
+            param_leaves = jax.tree_util.tree_leaves(self.state.params)
+            opt_leaves = jax.tree_util.tree_leaves(self.state.opt_state)
+            scalars = [self.state.step, self.state.loss_scale,
+                       self.state.growth_count, self.state.hysteresis,
+                       self.state.skipped_steps]
+            dcn_leaves = [] if self.state.dcn_error is None else \
+                jax.tree_util.tree_leaves(self.state.dcn_error)
+            fetched = [np.asarray(x) for x in jax.device_get(
+                param_leaves + opt_leaves + scalars + dcn_leaves)]
+            n_p, n_o = len(param_leaves), len(opt_leaves)
+            host_param_leaves = fetched[:n_p]
+            host_opt_leaves = fetched[n_p:n_p + n_o]
+            step_v, scale_v, growth_v, hyst_v, skipped_v = \
+                fetched[n_p + n_o:n_p + n_o + 5]
+            host_dcn_leaves = fetched[n_p + n_o + 5:]
+            self.skipped_steps = int(skipped_v)
+            meta["skipped_steps"] = self.skipped_steps
+            blobs += self._snapshot_model_blobs(meta, host_param_leaves)
+            scalars_blob = {"__scalars__": {
+                "step": step_v, "loss_scale": scale_v,
+                "growth_count": growth_v, "hysteresis": hyst_v,
+                "skipped": skipped_v}}
+            blobs += self._snapshot_optim_blobs(
+                meta, host_opt_leaves, scalars_blob, host_dcn_leaves)
+        return CheckpointSnapshot(
+            save_dir=save_dir, tag=str(tag), save_latest=save_latest,
+            meta=meta, blobs=blobs,
+            is_writer=jax.process_index() == 0, fsync=self._ckpt_fsync)
 
-        if jax.process_index() == 0:
-            with open(os.path.join(path, "engine_meta.json"), "w") as f:
-                json.dump(meta, f)
-            if save_latest:
-                with open(os.path.join(save_dir, LATEST_FILE), "w") as f:
-                    f.write(str(tag))
-        log_dist(f"saved checkpoint {path}", ranks=[0])
+    def preempt_save(self, reason: str = "SIGTERM") -> bool:
+        """Final snapshot+commit for a dying run — the PreemptSaver's
+        SIGTERM entry (callable directly). When a background write is
+        already in flight, WAIT for it instead of snapshotting again:
+        that commit IS the final checkpoint. When the current step is
+        already saved, do nothing. True when ``latest`` names a
+        checkpoint of the current step on return."""
+        if not self._ckpt_dir:
+            return False
+        ck = self._async_ckpt
+        awaited_ok = True
+        if ck is not None and ck.in_flight:
+            awaited_ok = bool(ck.wait(timeout=self._ckpt_writer_timeout))
+            self.telemetry.event("preempt_save", {
+                "reason": reason, "mode": "awaited_inflight",
+                "ok": awaited_ok})
+        # _last_saved_step is stamped at SUBMIT time; only trust it when
+        # the writer actually committed — a failed (or still-wedged)
+        # background write means `latest` never flipped, and skipping
+        # here would lose up to snapshot_every steps on the exact event
+        # this handler exists for. Fall through to the inline save
+        # instead.
+        write_failed = ck is not None and ck.last_error is not None
+        if awaited_ok and not write_failed and \
+                self._last_saved_step == self.global_steps:
+            return True
+        # Inline save even under async config: the process is dying and
+        # a queued write would die with it.
+        with self.telemetry.span("checkpoint_save", tag="preempt"):
+            snap = self._snapshot_checkpoint(self._ckpt_dir, None, None,
+                                             True)
+            commit_snapshot(snap)
+        if write_failed:
+            # The inline commit just superseded the lost write: latest
+            # now names the CURRENT step, so the stale error must not
+            # fail a later save for an already-recovered checkpoint.
+            ck.last_error = None
+        self._last_saved_step = self.global_steps
+        self.telemetry.event("preempt_save", {
+            "reason": reason, "mode": "saved", "tag": snap.tag,
+            "step": self.global_steps})
+        log_dist(f"preemption save: committed {snap.path}", ranks=[0])
         return True
 
     @staticmethod
@@ -3665,65 +3863,89 @@ class DeepSpeedEngine:
         return axes
 
     @staticmethod
-    def _write_shards(path: str, fmt: str, n: int, leaves, axes,
-                      extras_shard0: Optional[Dict[str, Any]] = None) -> None:
-        """Write one msgpack file per rank with that rank's slices;
-        replicated leaves and extras ride shard 0 only."""
-        for r in range(n):
+    def _shard_blob_builders(fmt: str, n: int, leaves, axes,
+                             extras_shard0: Optional[Dict[str, Any]] = None,
+                             groups: Optional[Dict[str, Any]] = None):
+        """One LAZY msgpack builder per rank with that rank's slices of
+        the already-fetched HOST leaves; replicated leaves and extras
+        ride shard 0 only. Slicing host arrays is views — the expensive
+        serialization happens when the builder runs, on the writer
+        thread under async saving. ``groups`` adds key-prefixed leaf
+        families to every shard file (the DCN error-feedback buffers
+        ride the optim shards under ``dcnN`` keys)."""
+        groups = groups or {}
+
+        def build(r: int) -> bytes:
             blob: Dict[str, Any] = {}
-            for i, (leaf, ax) in enumerate(zip(leaves, axes)):
-                if ax is None:
-                    if r == 0:
-                        blob[str(i)] = np.asarray(jax.device_get(leaf))
-                    continue
-                c = leaf.shape[ax] // n
-                sl = [slice(None)] * leaf.ndim
-                sl[ax] = slice(r * c, (r + 1) * c)
-                blob[str(i)] = np.asarray(jax.device_get(leaf[tuple(sl)]))
+
+            def put(prefix, lvs, axs):
+                for i, (leaf, ax) in enumerate(zip(lvs, axs)):
+                    if ax is None:
+                        if r == 0:
+                            blob[f"{prefix}{i}"] = np.asarray(leaf)
+                        continue
+                    c = leaf.shape[ax] // n
+                    sl = [slice(None)] * leaf.ndim
+                    sl[ax] = slice(r * c, (r + 1) * c)
+                    blob[f"{prefix}{i}"] = np.ascontiguousarray(
+                        leaf[tuple(sl)])
+
+            put("", leaves, axes)
+            for prefix, (glvs, gaxs) in groups.items():
+                put(prefix, glvs, gaxs)
             if r == 0 and extras_shard0:
                 blob.update(extras_shard0)
-            if jax.process_index() == 0:
-                with open(os.path.join(path, fmt.format(r)), "wb") as f:
-                    f.write(flax_serialization.msgpack_serialize(blob))
+            return flax_serialization.msgpack_serialize(blob)
 
-    def _save_model_states(self, path: str, meta: Dict[str, Any]) -> None:
-        """Model params: single mp_rank_00 file, or per-TP-rank slice files
-        when mp > 1 (reference mp_rank_XX naming, engine.py:1275-1280)."""
+        return [(fmt.format(r), lambda r=r: build(r)) for r in range(n)]
+
+    def _snapshot_model_blobs(self, meta: Dict[str, Any],
+                              host_param_leaves):
+        """Model blob builders from the already-fetched host leaves:
+        single mp_rank_00 file, or per-TP-rank slice files when mp > 1
+        (reference mp_rank_XX naming, engine.py:1275-1280)."""
         mp = int(self.mesh.shape.get(MP_AXIS, 1))
-        param_leaves = jax.tree_util.tree_leaves(self.state.params)
         sh_leaves = jax.tree_util.tree_leaves(self._state_shardings.params)
-        axes = self._effective_axes(param_leaves, sh_leaves, MP_AXIS, mp)
+        axes = self._effective_axes(host_param_leaves, sh_leaves, MP_AXIS, mp)
         if mp > 1 and any(ax is not None for ax in axes):
             meta["mp_shards"] = mp
             meta["param_shard_axes"] = axes
-            self._write_shards(path, MODEL_FILE_FMT, mp, param_leaves, axes)
-            return
-        host_params = jax.tree_util.tree_map(
-            lambda x: np.asarray(jax.device_get(x)), self.state.params)
-        if jax.process_index() == 0:
-            with open(os.path.join(path, MODEL_FILE), "wb") as f:
-                f.write(flax_serialization.to_bytes({"module": host_params}))
+            return self._shard_blob_builders(MODEL_FILE_FMT, mp,
+                                             host_param_leaves, axes)
+        treedef = jax.tree_util.tree_structure(self.state.params)
+        host_params = jax.tree_util.tree_unflatten(treedef,
+                                                   host_param_leaves)
+        return [(MODEL_FILE,
+                 lambda hp=host_params:
+                 flax_serialization.to_bytes({"module": hp}))]
 
-    def _save_optim_shards(self, path: str, meta: Dict[str, Any]) -> None:
-        """One optim file per dp rank holding that rank's ZeRO shard
+    def _snapshot_optim_blobs(self, meta: Dict[str, Any], host_opt_leaves,
+                              scalars_blob: Dict[str, Any],
+                              host_dcn_leaves):
+        """One optim blob per dp rank holding that rank's ZeRO shard
         (zero_pp_rank_D naming, engine.py:1262-1268). Scalars and
-        replicated leaves ride shard 0."""
+        replicated leaves ride shard 0; the multislice DCN
+        error-feedback buffers (when compression is live) ride every
+        shard under ``dcnN`` keys, dp-sliced like the moments — so a
+        resume no longer restarts the feedback at zero (the old
+        documented one-step bias)."""
         dp = self.dp_size
-        opt_leaves = jax.tree_util.tree_leaves(self.state.opt_state)
         sh_leaves = jax.tree_util.tree_leaves(self._state_shardings.opt_state)
-        axes = self._effective_axes(opt_leaves, sh_leaves, DP_AXIS, dp)
+        axes = self._effective_axes(host_opt_leaves, sh_leaves, DP_AXIS, dp)
         meta["optim_shards"] = dp
         meta["optim_shard_axes"] = axes
-        scalars = {"__scalars__": {
-            "step": np.asarray(jax.device_get(self.state.step)),
-            "loss_scale": np.asarray(jax.device_get(self.state.loss_scale)),
-            "growth_count": np.asarray(
-                jax.device_get(self.state.growth_count)),
-            "hysteresis": np.asarray(jax.device_get(self.state.hysteresis)),
-            "skipped": np.asarray(jax.device_get(self.state.skipped_steps)),
-        }}
-        self._write_shards(path, OPTIM_SHARD_FMT, dp, opt_leaves, axes,
-                           extras_shard0=scalars)
+        groups: Dict[str, Any] = {}
+        if host_dcn_leaves:
+            dcn_sh = jax.tree_util.tree_leaves(
+                self._state_shardings.dcn_error)
+            dcn_axes = self._effective_axes(host_dcn_leaves, dcn_sh,
+                                            DP_AXIS, dp)
+            meta["dcn_error_shard_axes"] = dcn_axes
+            groups["dcn"] = (host_dcn_leaves, dcn_axes)
+        return self._shard_blob_builders(OPTIM_SHARD_FMT, dp,
+                                         host_opt_leaves, axes,
+                                         extras_shard0=scalars_blob,
+                                         groups=groups)
 
     def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
                         load_module_strict: bool = True,
@@ -3747,16 +3969,32 @@ class DeepSpeedEngine:
             with open(latest) as f:
                 tag = f.read().strip()
         path = os.path.join(load_dir, str(tag))
-        meta_file = os.path.join(path, "engine_meta.json")
+        if not os.path.isdir(path):
+            logger.warning(f"checkpoint {path} not found; nothing loaded")
+            return None, {}
+        if not is_complete(path):
+            # Torn tag: the commit protocol writes engine_meta.json LAST
+            # (inside the tmp dir, before the atomic rename), so a tag
+            # dir without it was produced by an interrupted pre-protocol
+            # writer. Refuse cleanly BEFORE touching any engine state —
+            # a half-restored engine is worse than no restore.
+            logger.warning(
+                f"checkpoint {path} is INCOMPLETE (no engine_meta.json "
+                "completeness seal) — a torn/interrupted save; refusing "
+                "to load it. Delete the tag dir (and repoint 'latest' at "
+                "an intact tag) to clear this.")
+            return None, {}
+        meta_file = os.path.join(path, META_FILE)
         meta = {}
         if os.path.isfile(meta_file):
             with open(meta_file) as f:
                 meta = json.load(f)
 
-        # cast_params is re-derived by _place_state; dcn_error is not
-        # checkpointed (it resets to zero on resume) — fetching either
-        # here would pull full-model-sized trees device-to-host for
-        # nothing.
+        # cast_params is re-derived by _place_state; dcn_error restores
+        # from its own shard keys using tree STRUCTURE only — fetching
+        # either here would pull full-model-sized trees device-to-host
+        # for nothing (the skip-fetch survives whether or not
+        # compression is on).
         host_state = jax.device_get(self.state.replace(cast_params=None,
                                                        dcn_error=None))
         if load_optimizer_states and \
@@ -3842,11 +4080,17 @@ class DeepSpeedEngine:
             # dp rank's file; _place_state re-partitions for the CURRENT
             # mesh — elastic dp-resize (stage1.py:848-1106).
             saved_dp = int(meta["optim_shards"])
+            # One parse of the shard files feeds the optim state, the
+            # scalars, AND the dcn error family — these are the largest
+            # blobs in the checkpoint; deserializing them twice would
+            # double the load's heaviest phase.
+            shard_blobs = self._read_shard_blobs(path, OPTIM_SHARD_FMT,
+                                                 saved_dp)
             assembled = self._assemble_shards(
                 path, OPTIM_SHARD_FMT, saved_dp, meta["optim_shard_axes"],
-                host_state.opt_state)
+                host_state.opt_state, blobs=shard_blobs)
             if assembled is not None:
-                scalars = self._read_optim_scalars(path)
+                scalars = shard_blobs[0]["__scalars__"]
                 updates.update(
                     opt_state=assembled,
                     step=jnp.asarray(scalars["step"]),
@@ -3854,6 +4098,26 @@ class DeepSpeedEngine:
                     growth_count=jnp.asarray(scalars["growth_count"]),
                     hysteresis=jnp.asarray(scalars["hysteresis"]),
                     skipped_steps=jnp.asarray(scalars["skipped"]))
+            if self.state.dcn_error is not None:
+                # DCN-compression error feedback: restore the carried
+                # residuals (dp/slice-elastic like everything else — a
+                # slice-count change shape-mismatches per leaf and keeps
+                # the fresh zeros with a warning). Skipped entirely when
+                # compression is off.
+                if meta.get("dcn_error_shard_axes"):
+                    dcn = self._assemble_shards(
+                        path, OPTIM_SHARD_FMT, saved_dp,
+                        meta["dcn_error_shard_axes"],
+                        self.state.dcn_error, key_prefix="dcn",
+                        blobs=shard_blobs)
+                    if dcn is not None:
+                        updates["dcn_error"] = dcn
+                else:
+                    logger.warning(
+                        f"checkpoint {path} carries no dcn_error "
+                        "buffers (pre-resilience save); DCN error "
+                        "feedback restarts at zero — a one-step "
+                        "compression bias, self-correcting")
         elif load_optimizer_states:
             optim_file = os.path.join(path, OPTIM_FILE_FMT)
             if os.path.isfile(optim_file):
@@ -3892,12 +4156,12 @@ class DeepSpeedEngine:
                  ranks=[0])
         return path, meta.get("client_state", {})
 
-    def _assemble_shards(self, path: str, fmt: str, n: int, axes,
-                         target_tree):
-        """Read ``n`` shard files and concatenate each leaf along its
-        recorded axis (replicated leaves come from shard 0). Returns the
-        full tree with ``target_tree``'s structure, or None if files are
-        missing."""
+    @staticmethod
+    def _read_shard_blobs(path: str, fmt: str, n: int):
+        """Deserialize all ``n`` shard files once (the heaviest part of
+        a load — full Adam moment shards); None if any is missing.
+        Callers assembling multiple leaf families from the same files
+        (optim state + dcn error feedback) share one parse."""
         blobs = []
         for r in range(n):
             fp = os.path.join(path, fmt.format(r))
@@ -3906,6 +4170,21 @@ class DeepSpeedEngine:
                 return None
             with open(fp, "rb") as f:
                 blobs.append(flax_serialization.msgpack_restore(f.read()))
+        return blobs
+
+    def _assemble_shards(self, path: str, fmt: str, n: int, axes,
+                         target_tree, key_prefix: str = "",
+                         blobs=None):
+        """Read ``n`` shard files (or reuse pre-parsed ``blobs``) and
+        concatenate each leaf along its recorded axis (replicated leaves
+        come from shard 0). Returns the full tree with ``target_tree``'s
+        structure, or None if files are missing. ``key_prefix`` selects
+        a prefixed leaf family riding the same files (the DCN error
+        buffers' ``dcnN`` keys)."""
+        if blobs is None:
+            blobs = self._read_shard_blobs(path, fmt, n)
+        if blobs is None:
+            return None
         leaves, treedef = jax.tree_util.tree_flatten(target_tree)
         if len(leaves) != len(axes):
             raise ValueError(
@@ -3915,9 +4194,10 @@ class DeepSpeedEngine:
         out = []
         for i, (leaf, ax) in enumerate(zip(leaves, axes)):
             if ax is None:
-                val = blobs[0][str(i)]
+                val = blobs[0][f"{key_prefix}{i}"]
             else:
-                val = np.concatenate([b[str(i)] for b in blobs], axis=int(ax))
+                val = np.concatenate([b[f"{key_prefix}{i}"] for b in blobs],
+                                     axis=int(ax))
             if hasattr(leaf, "shape") and np.shape(val) != np.shape(leaf):
                 # Elastic-incompatible leaf (e.g. onebit worker_error's
                 # per-rank [dp] axis under a different dp): keep the current
@@ -3933,11 +4213,6 @@ class DeepSpeedEngine:
         raise NotImplementedError(
             "checkpoint has pipeline per-layer files; load it through a "
             "PipelineEngine")
-
-    def _read_optim_scalars(self, path: str) -> Dict[str, Any]:
-        with open(os.path.join(path, OPTIM_SHARD_FMT.format(0)), "rb") as f:
-            blob = flax_serialization.msgpack_restore(f.read())
-        return blob["__scalars__"]
 
     def _checkpoint_tag_validation(self, tag: str) -> None:
         """Cross-host tag consistency vote (engine.py:1455-1470): under SPMD

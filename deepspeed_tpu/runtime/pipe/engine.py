@@ -289,27 +289,31 @@ class PipelineEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------ #
     LAYER_FILE_FMT = "layer_{:02d}-model_states.msgpack"
 
-    def _save_model_states(self, path, meta):
-        import os
+    def _snapshot_model_blobs(self, meta, host_param_leaves):
         import numpy as np
         from flax import serialization
         if self.pipeline_module is None:
-            return super()._save_model_states(path, meta)
-        host = jax.device_get(self.state.params)
+            return super()._snapshot_model_blobs(meta, host_param_leaves)
+        # Host leaves arrive already fetched (the engine's one batched
+        # device_get); reassemble the params tree and build one LAZY
+        # blob per layer file — tied params: first owner writes it.
+        host = jax.tree_util.tree_unflatten(
+            jax.tree_util.tree_structure(self.state.params),
+            host_param_leaves)
         layer_files = {}
-        with self.telemetry.span("checkpoint_save",
-                                 what="pipeline_layer_states"):
-            for i in range(len(self.pipeline_module.layers)):
-                key = self.pipeline_module.param_key(i)
-                if key in layer_files:
-                    continue    # tied params: first owner writes the file
-                fname = self.LAYER_FILE_FMT.format(i)
-                layer_files[key] = fname
-                blob = jax.tree_util.tree_map(np.asarray, host.get(key, {}))
-                if jax.process_index() == 0:
-                    with open(os.path.join(path, fname), "wb") as f:
-                        f.write(serialization.to_bytes(blob))
+        blobs = []
+        for i in range(len(self.pipeline_module.layers)):
+            key = self.pipeline_module.param_key(i)
+            if key in layer_files:
+                continue
+            fname = self.LAYER_FILE_FMT.format(i)
+            layer_files[key] = fname
+            layer_tree = jax.tree_util.tree_map(np.asarray,
+                                                host.get(key, {}))
+            blobs.append((fname, lambda t=layer_tree:
+                          serialization.to_bytes(t)))
         meta["pipeline_layer_files"] = layer_files
+        return blobs
 
     def _load_pipeline_layer_states(self, path, meta, params_target):
         import os

@@ -396,7 +396,8 @@ class TestBenchGate:
                     "kernel_speedup": None, "zero3_overlap": None,
                     "health": None, "hbm_per_token": None,
                     "accept_rate": None, "moe_drop": None,
-                    "dcn_bytes": None}
+                    "dcn_bytes": None, "ckpt_share": None,
+                    "ckpt_every": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}
@@ -432,6 +433,38 @@ class TestBenchGate:
         assert bg.main([old, slow]) == 1
         assert bg.main([old, laggy]) == 1
         assert bg.main([pre, old]) == 0        # pre-serving round skips
+
+    def test_gate_checkpoint_exposed_share(self, tmp_path):
+        """Resilience rounds gate the checkpoint-EXPOSED goodput share
+        (new side, absolute ceiling); pre-resilience rounds skip, never
+        fail. Both carrier shapes parse: RESILIENCE_BENCH.json's
+        top-level record and a TELEMETRY.json goodput sub-dict."""
+        bg = load_bench_gate()
+        m = bg.extract_metrics({"checkpoint": {
+            "snapshot_every": 50, "exposed_share": 0.008,
+            "exposed_s": 0.01}})
+        assert m["ckpt_share"] == 0.008 and m["ckpt_every"] == 50
+        m = bg.extract_metrics({"goodput": {
+            "goodput_fraction": 0.96,
+            "checkpoint": {"exposed_share": 0.01, "exposed_s": 0.02,
+                           "snapshot_every": 50}}})
+        assert m["ckpt_share"] == 0.01
+        # A non-checkpointing run (zero exposed wall) carries no gateable
+        # share — it must skip, not trivially pass forever.
+        m = bg.extract_metrics({"goodput": {
+            "goodput_fraction": 0.96,
+            "checkpoint": {"exposed_share": 0.0, "exposed_s": 0.0}}})
+        assert m["ckpt_share"] is None
+        old = self._write(tmp_path, "old.json", {"mfu": 0.5})
+        ok = self._write(tmp_path, "ck_ok.json", {"checkpoint": {
+            "snapshot_every": 50, "exposed_share": 0.008,
+            "exposed_s": 0.01}})
+        bad = self._write(tmp_path, "ck_bad.json", {"checkpoint": {
+            "snapshot_every": 50, "exposed_share": 0.12,
+            "exposed_s": 0.5}})
+        assert bg.main([old, ok]) == 0
+        assert bg.main([old, bad]) == 1
+        assert bg.main([ok, old]) == 0         # pre-resilience new side
 
     def test_extract_paged_serving_fields(self):
         bg = load_bench_gate()

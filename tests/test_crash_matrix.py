@@ -1,0 +1,184 @@
+"""Crash matrix for the two-phase atomic checkpoint commit: a REAL
+process SIGKILLed at exact protocol offsets (runtime/async_ckpt.py's
+DS_CKPT_CRASH_POINT injection — the process kills ITSELF with SIGKILL at
+the named byte offset, so there is no cleanup, no atexit, no flush), and
+an external kill landing mid-write. After every kill, ``latest`` must
+name a FULLY loadable checkpoint — the previous one when the kill
+preceded the atomic rename/flip, either one at the flip boundary — and
+the exit code must be the honest ``-SIGKILL`` (PR-10 discipline).
+
+Matrix (ISSUE 15): kill during snapshot, during blob write, between the
+meta seal and the ``latest`` flip, and during idle — each
+subprocess-tested with a loadable-``latest`` assertion.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.async_ckpt import is_complete
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import build_mesh
+
+from simple_model import simple_loss_fn, simple_model_params, random_batch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Child contract: train 2 steps, commit a GOOD checkpoint (latest ->
+# "good"), train 1 more step, arm the crash point, attempt a second
+# save ("bad") and die INSIDE it. The parent then asserts what survived.
+CHILD = """
+import os, sys, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("XLA_FLAGS",
+                      "--xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+sys.path.insert(0, {tests!r})
+sys.path.insert(0, {repo!r})
+from simple_model import simple_model_params, simple_loss_fn, random_batch
+from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+from deepspeed_tpu.parallel.topology import build_mesh
+
+d = {ckdir!r}
+mesh = build_mesh(devices=jax.devices()[:2])
+cfg = {{"train_batch_size": 16, "train_micro_batch_size_per_gpu": 8,
+       "gradient_accumulation_steps": 1,
+       "zero_optimization": {{"stage": 2}},
+       "optimizer": {{"type": "Adam", "params": {{"lr": 1e-2}}}},
+       "steps_per_print": 10 ** 9,
+       "checkpoint": {{"async": {use_async}}}}}
+eng = DeepSpeedEngine(model=simple_loss_fn,
+                      model_params=simple_model_params(
+                          jax.random.PRNGKey(0)), config=cfg, mesh=mesh)
+eng.train_batch(random_batch(16, seed=0))
+eng.train_batch(random_batch(16, seed=1))
+eng.save_checkpoint(d, tag="good")
+if eng._async_ckpt is not None:
+    assert eng._async_ckpt.wait(timeout=60)
+open(os.path.join(d, "GOOD_DONE"), "w").write("1")
+eng.train_batch(random_batch(16, seed=2))
+os.environ["DS_CKPT_CRASH_POINT"] = {point!r}
+eng.save_checkpoint(d, tag="bad")
+if eng._async_ckpt is not None:
+    eng._async_ckpt.wait(timeout=60)
+print("SURVIVED_THE_CRASH_POINT")
+"""
+
+
+def _run_child(ckdir, point, use_async=False, timeout=240):
+    script = os.path.join(ckdir, "child.py")
+    with open(script, "w") as f:
+        f.write(CHILD.format(tests=os.path.join(REPO, "tests"), repo=REPO,
+                             ckdir=ckdir, point=point,
+                             use_async=use_async))
+    p = subprocess.run([sys.executable, script], capture_output=True,
+                       text=True, timeout=timeout)
+    return p
+
+
+def _load_latest(ckdir, seed=9):
+    mesh = build_mesh(devices=jax.devices()[:2])
+    cfg = {"train_batch_size": 16, "train_micro_batch_size_per_gpu": 8,
+           "gradient_accumulation_steps": 1,
+           "zero_optimization": {"stage": 2},
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+           "steps_per_print": 10 ** 9}
+    eng = DeepSpeedEngine(model=simple_loss_fn,
+                          model_params=simple_model_params(
+                              jax.random.PRNGKey(seed)),
+                          config=cfg, mesh=mesh)
+    path, client = eng.load_checkpoint(ckdir)
+    return eng, path
+
+
+@pytest.mark.parametrize("point,expected_steps", [
+    # Half of a blob file is on disk inside bad.tmp; the rename never
+    # ran, latest still says "good".
+    ("mid_blob_write", {2}),
+    # Every blob landed, the seal (engine_meta.json) did not: bad.tmp is
+    # unsealed garbage, latest says "good".
+    ("pre_seal", {2}),
+    # Sealed tmp dir, not yet renamed: latest says "good".
+    ("pre_commit", {2}),
+    # Renamed ("bad" is complete on disk) but latest never flipped:
+    # loading latest gives "good" — the older-but-consistent outcome.
+    ("pre_latest", {2}),
+    # latest tmp file written, os.replace not reached: latest still
+    # "good"; "bad" exists sealed. Either target is loadable.
+    ("mid_latest", {2, 3}),
+])
+def test_kill_at_protocol_offset_leaves_latest_loadable(
+        tmp_path, point, expected_steps):
+    ckdir = str(tmp_path)
+    p = _run_child(ckdir, point)
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr[-2000:])
+    assert "SURVIVED_THE_CRASH_POINT" not in p.stdout
+    assert os.path.exists(os.path.join(ckdir, "GOOD_DONE")), \
+        p.stderr[-2000:]
+    # The good tag is intact and sealed no matter where the kill landed.
+    assert is_complete(os.path.join(ckdir, "good"))
+    eng, path = _load_latest(ckdir)
+    assert path is not None, f"latest unloadable after kill at {point}"
+    assert eng.global_steps in expected_steps, \
+        (point, eng.global_steps)
+    # The resumed engine trains on.
+    loss = float(jax.device_get(eng.train_batch(random_batch(16, seed=7))))
+    assert np.isfinite(loss)
+
+
+def test_kill_after_async_snapshot_before_write(tmp_path):
+    """Async path: the kill lands after the snapshot fetch, before any
+    byte is written — the checkpoint is simply lost, latest intact."""
+    ckdir = str(tmp_path)
+    p = _run_child(ckdir, "after_snapshot", use_async=True)
+    assert p.returncode == -signal.SIGKILL, (p.returncode, p.stderr[-2000:])
+    eng, path = _load_latest(ckdir)
+    assert path is not None and path.endswith("good")
+    assert eng.global_steps == 2
+    assert not os.path.exists(os.path.join(ckdir, "bad"))
+
+
+def test_external_kill_mid_background_write(tmp_path):
+    """The idle/external case: SIGKILL from OUTSIDE while the slowed
+    background writer is mid-commit. No crash-point cooperation — the
+    honest preemption. latest must still name the good checkpoint."""
+    ckdir = str(tmp_path)
+    script = os.path.join(ckdir, "child.py")
+    with open(script, "w") as f:
+        f.write(CHILD.format(tests=os.path.join(REPO, "tests"), repo=REPO,
+                             ckdir=ckdir, point="", use_async=True))
+    env = dict(os.environ)
+    env["DS_CKPT_TEST_WRITE_DELAY_S"] = "0.5"
+    p = subprocess.Popen([sys.executable, script],
+                         stdout=subprocess.DEVNULL,
+                         stderr=subprocess.DEVNULL, env=env)
+    try:
+        marker = os.path.join(ckdir, "GOOD_DONE")
+        t0 = time.time()
+        while not os.path.exists(marker):
+            time.sleep(0.05)
+            assert p.poll() is None, "child died before the good save"
+            assert time.time() - t0 < 180, "child never reached GOOD_DONE"
+        # The second (bad) save's write is slowed to >= 1.5s; killing
+        # shortly after the marker lands mid-write of either save's
+        # successor with high probability — and wherever it lands, the
+        # protocol owes us a loadable latest.
+        time.sleep(0.7)
+        p.kill()
+        p.wait(timeout=60)
+    finally:
+        if p.poll() is None:
+            p.kill()
+    assert p.returncode == -signal.SIGKILL
+    eng, path = _load_latest(ckdir)
+    assert path is not None
+    assert eng.global_steps in (2, 3)
+    loss = float(jax.device_get(eng.train_batch(random_batch(16, seed=7))))
+    assert np.isfinite(loss)

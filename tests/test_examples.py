@@ -9,6 +9,14 @@ Kept honest by parsing the scripts' own stdout contract ("losses: ..." +
 just run — the first-quarter vs last-quarter window means of its printed
 loss curve must DECREASE (the module's "finite, decreasing loss" claim;
 the reference's func tests compare full loss curves).
+
+The gpt2 flagship configs (ZeRO-2, ZeRO-Offload, 1-bit Adam, 1F1B
+pipeline) train on REAL text — byte-level LM over the vendored
+license-clean corpus (examples/data/corpus.txt, see its README) — with
+loss-curve gates, closing VERDICT.md's top gap (every e2e example used
+to train on synthetic random tokens). A byte-level model starts at the
+ln(256) ~= 5.5 uniform floor and must cut into genuine English
+statistics to pass.
 """
 import os
 import re
@@ -21,6 +29,7 @@ import pytest
 pytestmark = pytest.mark.slow  # whole-module slow tier (see conftest)
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CORPUS = os.path.join("examples", "data", "corpus.txt")
 
 
 def run_example(rel, *args, timeout=420):
@@ -60,22 +69,41 @@ def test_bert_example_learns():
     assert_decreasing(curve)
 
 
-def test_gpt2_example_zero2():
-    _, curve = run_example("examples/gpt2/train.py",
-                           "--config", "ds_config_zero2.json",
-                           "--steps", "24")
-    assert_decreasing(curve)
+def test_gpt2_example_zero2_real_text():
+    loss, curve = run_example("examples/gpt2/train.py",
+                              "--config", "ds_config_zero2.json",
+                              "--data", CORPUS, "--steps", "24")
+    assert curve[0] < 7.0                 # near the ln(256)~5.5 start
+    assert loss < 5.0, loss               # well under the uniform floor
+    assert_decreasing(curve, factor=0.85)
 
 
-def test_gpt2_example_onebit():
-    _, curve = run_example("examples/gpt2/train.py",
-                           "--config", "ds_config_onebit.json",
-                           "--steps", "48")
-    assert_decreasing(curve)
+def test_gpt2_example_offload_real_text():
+    loss, curve = run_example("examples/gpt2/train.py",
+                              "--config", "ds_config_offload.json",
+                              "--data", CORPUS, "--steps", "24")
+    assert loss < 5.0, loss
+    assert_decreasing(curve, factor=0.85)
 
 
-def test_gpt2_example_pipeline_1f1b():
-    _, curve = run_example("examples/gpt2/train.py",
-                           "--config", "ds_config_pipeline.json",
-                           "--pipeline", "--steps", "24")
-    assert_decreasing(curve)
+def test_gpt2_example_onebit_real_text():
+    loss, curve = run_example("examples/gpt2/train.py",
+                              "--config", "ds_config_onebit.json",
+                              "--data", CORPUS, "--steps", "48")
+    assert loss < 5.0, loss
+    assert_decreasing(curve, factor=0.85)
+
+
+def test_gpt2_example_pipeline_1f1b_real_text():
+    from capability import partial_auto_skip_reason
+    reason = partial_auto_skip_reason()
+    if reason:
+        # pp=2 x dp=4 lowers to a partially-manual shard_map this jax
+        # cannot compile — the same capability gate the pipe tier uses.
+        pytest.skip(reason)
+    loss, curve = run_example("examples/gpt2/train.py",
+                              "--config", "ds_config_pipeline.json",
+                              "--pipeline", "--data", CORPUS,
+                              "--steps", "24")
+    assert loss < 5.0, loss
+    assert_decreasing(curve, factor=0.85)

@@ -49,7 +49,14 @@ never fail. Multislice rounds (a ``multislice`` record in
 MULTISLICE_BENCH.json, or a TELEMETRY.json roofline ``comm_tiers``
 section) gate DCN bytes/step on a RELATIVE rise beyond ``--dcn-rise``
 (default 10%) — the slow tier is the scale-out ceiling; pre-multislice
-rounds skip, never fail. A metric missing on either
+rounds skip, never fail. Resilience rounds (a ``checkpoint`` record in
+RESILIENCE_BENCH.json from ``tools/crashkill.py bench``, or a
+TELEMETRY.json goodput section carrying a ``checkpoint`` sub-dict with
+nonzero exposed wall) gate the checkpoint-EXPOSED goodput share on the
+NEW side against an ABSOLUTE ceiling (``--ckpt-share-max``, default 5%
+— the ISSUE-15 acceptance bar at ``snapshot_every: 50``); background
+write wall overlaps training and is not charged. Pre-resilience rounds
+skip, never fail. A metric missing on either
 side is skipped with a notice, never a failure — rounds recorded before
 this tool (or before the serving tier / health layer) existed have no
 such field, and the gate must not retroactively break them. Exit 0 =
@@ -156,6 +163,25 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         if isinstance(tiers, dict) and \
                 tiers.get("wire_bytes_dcn") is not None:
             dcn_bytes = float(tiers["wire_bytes_dcn"])
+    # Resilience shape: RESILIENCE_BENCH.json's top-level `checkpoint`
+    # record (tools/crashkill.py bench), or a TELEMETRY.json goodput
+    # section's `checkpoint` sub-dict — the gated figure is the
+    # checkpoint-EXPOSED goodput share (background write wall overlaps
+    # and is free). Validated on the NEW side alone against an absolute
+    # ceiling; pre-resilience rounds carry neither -> skipped, never
+    # failed.
+    ckpt_share: Optional[float] = None
+    ckpt_every: Optional[int] = None
+    cksec = doc.get("checkpoint")
+    if not (isinstance(cksec, dict) and
+            cksec.get("exposed_share") is not None) and \
+            isinstance(doc.get("goodput"), dict):
+        cksec = doc["goodput"].get("checkpoint")
+    if isinstance(cksec, dict) and cksec.get("exposed_share") is not None \
+            and float(cksec.get("exposed_s", 1.0)) > 0.0:
+        ckpt_share = float(cksec["exposed_share"])
+        if cksec.get("snapshot_every"):
+            ckpt_every = int(cksec["snapshot_every"])
     # Health-layer TELEMETRY.json shape: validated (new side only), not
     # diffed. Pre-health rounds carry no section -> None -> skipped.
     health: Optional[Dict[str, Any]] = None
@@ -176,7 +202,8 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
-            "moe_drop": moe_drop, "dcn_bytes": dcn_bytes}
+            "moe_drop": moe_drop, "dcn_bytes": dcn_bytes,
+            "ckpt_share": ckpt_share, "ckpt_every": ckpt_every}
 
 
 def _round_key(path: str) -> Tuple[int, str]:
@@ -201,7 +228,8 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
          goodput_drop: float, serve_drop: float = 0.10,
          ttft_rise: float = 0.25, kernel_drop: float = 0.10,
          hbm_rise: float = 0.15, accept_floor: float = 0.05,
-         moe_drop_rise: float = 0.05, dcn_rise: float = 0.10) -> int:
+         moe_drop_rise: float = 0.05, dcn_rise: float = 0.10,
+         ckpt_share_max: float = 0.05) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -380,6 +408,27 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         print(f"moe drop fraction: skipped (no moe record in "
               f"{', '.join(missing)})")
 
+    # Checkpoint-exposed goodput share: NEW side only, against an
+    # ABSOLUTE ceiling — a checkpointing run that pays more than
+    # ckpt_share_max of its wall in exposed checkpoint time has lost
+    # the async overlap (the resilience subsystem's whole point).
+    # Pre-resilience rounds carry no checkpoint record -> skip, never
+    # fail.
+    if new["ckpt_share"] is not None:
+        compared += 1
+        cadence = (f" at snapshot_every={new['ckpt_every']}"
+                   if new["ckpt_every"] else "")
+        verdict = "OK" if new["ckpt_share"] <= ckpt_share_max \
+            else "REGRESSION"
+        print(f"checkpoint exposed share: {name_new}="
+              f"{new['ckpt_share']:.4%}{cadence} "
+              f"(ceiling {ckpt_share_max:.0%} abs): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        print(f"checkpoint exposed share: skipped (no checkpoint "
+              f"record in {name_new} — pre-resilience round)")
+
     # Health validation: NEW side only (defects, not diffs). Pre-health
     # rounds skip, never fail.
     nh = new.get("health")
@@ -443,6 +492,9 @@ def main(argv=None) -> int:
     ap.add_argument("--dcn-rise", type=float, default=0.10,
                     help="max tolerated RELATIVE rise of multislice "
                          "DCN bytes/step (default 0.10)")
+    ap.add_argument("--ckpt-share-max", type=float, default=0.05,
+                    help="ABSOLUTE ceiling on the checkpoint-exposed "
+                         "goodput share, new side (default 0.05)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -460,7 +512,7 @@ def main(argv=None) -> int:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
                     args.serve_drop, args.ttft_rise, args.kernel_drop,
                     args.hbm_rise, args.accept_floor, args.moe_drop_rise,
-                    args.dcn_rise)
+                    args.dcn_rise, args.ckpt_share_max)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -9,6 +9,13 @@
 #   --health / HEALTH_GATE=1 : run the dp=8 health self-check
 #       (tools/health_check.py): induced-NaN provenance, flight
 #       recorder + final marker, zero added hot-path device syncs.
+#   --resilience / RESILIENCE_GATE=1 : run the crash/kill/resume
+#       harness (tools/crashkill.py run --quick: real SIGTERM/SIGKILL
+#       at random steps incl. mid-write, loadable-latest probe after
+#       every kill, bit-exact same-dp trajectory, floor-bounded elastic
+#       trajectory) plus the goodput pricing bench (checkpoint-exposed
+#       share <= 5% and steady-state goodput >= 95% at
+#       snapshot_every: 50 on the dp=8 mesh -> RESILIENCE_BENCH.json).
 cd "$(dirname "$0")/.." || exit 1
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 for arg in "$@"; do
@@ -16,6 +23,7 @@ for arg in "$@"; do
     --bench-gate) BENCH_GATE=1 ;;
     --lint) LINT_GATE=1 ;;
     --health) HEALTH_GATE=1 ;;
+    --resilience) RESILIENCE_GATE=1 ;;
   esac
 done
 if [ "${BENCH_GATE:-0}" = "1" ]; then
@@ -26,5 +34,9 @@ if [ "${LINT_GATE:-0}" = "1" ]; then
 fi
 if [ "${HEALTH_GATE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python tools/health_check.py || rc=1
+fi
+if [ "${RESILIENCE_GATE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python tools/crashkill.py run --quick || rc=1
+  env JAX_PLATFORMS=cpu python tools/crashkill.py bench || rc=1
 fi
 exit $rc

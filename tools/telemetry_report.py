@@ -372,11 +372,30 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
                   if isinstance(rep.get("goodput"), dict)]
     goodput: Dict[str, Any] = {"available": bool(gp_windows)}
     if gp_windows:
-        bucket_keys = [k for k in gp_windows[0]
-                       if k.endswith("_s") and k != "window_s"]
+        # Only the ledger's CLOSED bucket set joins the accounted sum —
+        # everything else a window carries (`*_bg_s` background wall
+        # measured on another thread, sub-figures like
+        # `checkpoint_snapshot_s` that are subsets of a bucket, future
+        # additions) is reported-only, and summing it would double-count
+        # seconds the ledger deliberately kept apart. An allowlist keeps
+        # that exclusion fail-safe for sub-figures added later.
+        ledger_buckets = {"useful_compute", "data_stall", "recompile",
+                          "overflow_skipped", "checkpoint",
+                          "offload_exposed", "other"}
+
+        def _is_bucket(k: str) -> bool:
+            return k.endswith("_s") and k[:-2] in ledger_buckets
+
+        all_keys = set().union(*(w.keys() for w in gp_windows))
+        bucket_keys = sorted(k for k in all_keys if _is_bucket(k))
         totals = {k: sum(float(w.get(k, 0.0)) for w in gp_windows)
                   for k in bucket_keys}
         total_window = sum(float(w.get("window_s", 0.0)) for w in gp_windows)
+        ck_exposed = totals.get("checkpoint_s", 0.0)
+        ck_snapshot = sum(float(w.get("checkpoint_snapshot_s", 0.0))
+                          for w in gp_windows)
+        ck_write_bg = sum(float(w.get("checkpoint_write_bg_s", 0.0))
+                          for w in gp_windows)
         goodput.update({
             "windows": len(gp_windows),
             "total_window_s": round(total_window, 6),
@@ -390,6 +409,21 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "consistent": all(w.get("consistent", False)
                               for w in gp_windows),
         })
+        # The resilience split: exposed (paid) checkpoint wall vs the
+        # background writer's overlapped wall. exposed_share is what
+        # bench_gate's checkpoint gate reads.
+        goodput["checkpoint"] = {
+            "exposed_s": round(ck_exposed, 6),
+            "snapshot_s": round(ck_snapshot, 6),
+            "write_bg_s": round(ck_write_bg, 6),
+            "exposed_share": round(ck_exposed / total_window, 6)
+            if total_window > 0 else 0.0,
+        }
+        if isinstance(meta.get("checkpoint"), dict):
+            goodput["checkpoint"]["snapshot_every"] = \
+                meta["checkpoint"].get("snapshot_every")
+            goodput["checkpoint"]["async"] = \
+                meta["checkpoint"].get("async")
 
     # Serving: occupancy from the decode-step records, per-request
     # latency percentiles recomputed from the request_complete events
@@ -608,6 +642,9 @@ def main(argv=None) -> int:
     mfu = summary["mfu"].get("window_mfu") or \
         summary["mfu"].get("per_step_p50")
     gp = summary["goodput"].get("goodput_fraction")
+    ck = summary["goodput"].get("checkpoint")
+    ck_share = ck["exposed_share"] if isinstance(ck, dict) and \
+        ck.get("exposed_s", 0) > 0 else None
     bound = summary["roofline"].get("step_bound")
     srv = summary["serving"]
     hl = summary["health"]
@@ -625,6 +662,8 @@ def main(argv=None) -> int:
           + (f", mfu={mfu}" if mfu is not None else "")
           + (f", {bound}-bound" if bound else "")
           + (f", goodput={gp:.1%}" if gp is not None else "")
+          + (f", ckpt exposed={ck_share:.2%}"
+             if ck_share is not None else "")
           + (f", serving: occ={srv['occupancy_mean']}, "
              f"ttft p50={srv['ttft_ms']['p50']}ms"
              if srv.get("available") else "")
