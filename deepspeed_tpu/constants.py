@@ -557,6 +557,12 @@ MOE_Z_LOSS_WEIGHT_DEFAULT = 1e-3
 # data-parallel-replicated, no all-to-all (the dev/CI path).
 MOE_EXPERT_PARALLEL_SIZE = "expert_parallel_size"
 MOE_EXPERT_PARALLEL_SIZE_DEFAULT = 1
+# Expert-FFN compute path: the grouped-GEMM Pallas kernel
+# (ops/grouped_gemm.py) vs the batched einsum. "auto" = kernel on TPU,
+# einsum on CPU (DS_GROUPED_GEMM=0/1 overrides); True/False force —
+# the same contract as TransformerConfig.fused_kernels.
+MOE_GROUPED_GEMM = "grouped_gemm"
+MOE_GROUPED_GEMM_DEFAULT = "auto"
 
 #############################################
 # Mesh / parallelism (TPU-native extension keys)

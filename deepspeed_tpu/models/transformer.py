@@ -72,6 +72,10 @@ class TransformerConfig:
     # the scanned layer stack supports; freq > 1 needs
     # ``scan_layers=False``, since mixed block programs cannot share one
     # scan body). None = dense everywhere (unchanged).
+    # ``MoEConfig.grouped_gemm`` picks the expert-FFN program with the
+    # same contract as ``fused_kernels`` below: "auto"/True/False,
+    # DS_GROUPED_GEMM override, grouped Pallas kernel vs einsum pair
+    # (ops/grouped_gemm) — cfg-static, resolved inside _moe_tokens.
     moe: Any = None
     moe_layer_freq: int = 1
     # Fused elementwise Pallas kernels (ops/fused_elementwise): residual-

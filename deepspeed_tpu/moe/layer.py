@@ -50,6 +50,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..ops.grouped_gemm import grouped_ffn, grouped_gemm_enabled
 from ..parallel import comm
 from ..parallel.topology import DP_AXIS, EP_AXIS
 
@@ -76,6 +77,12 @@ class MoEConfig:
     aux_loss_weight: float = 1e-2
     z_loss_weight: float = 1e-3
     expert_parallel_size: int = 1       # ep — the `expert` mesh axis size
+    # Expert-FFN compute path: "auto" = the grouped-GEMM Pallas kernel
+    # on TPU / the einsum path on CPU (DS_GROUPED_GEMM=0/1 overrides),
+    # True/False force. cfg-static exactly like TransformerConfig.
+    # fused_kernels — flipping it changes the program, never the
+    # compiled signature or the checkpoint state.
+    grouped_gemm: Any = "auto"
 
     def __post_init__(self):
         assert self.num_experts >= 1, "num_experts must be >= 1"
@@ -86,6 +93,9 @@ class MoEConfig:
         assert self.num_experts % self.expert_parallel_size == 0, \
             (f"num_experts={self.num_experts} not divisible by "
              f"expert_parallel_size={self.expert_parallel_size}")
+        assert self.grouped_gemm in (True, False, "auto"), \
+            f"grouped_gemm must be True/False/'auto', got " \
+            f"{self.grouped_gemm!r}"
 
     @classmethod
     def from_ds_config(cls, moe_cfg) -> "MoEConfig":
@@ -95,7 +105,8 @@ class MoEConfig:
                    capacity_factor=moe_cfg.capacity_factor,
                    aux_loss_weight=moe_cfg.aux_loss_weight,
                    z_loss_weight=moe_cfg.z_loss_weight,
-                   expert_parallel_size=moe_cfg.expert_parallel_size)
+                   expert_parallel_size=moe_cfg.expert_parallel_size,
+                   grouped_gemm=getattr(moe_cfg, "grouped_gemm", "auto"))
 
 
 def expert_capacity(tokens: int, num_experts: int, top_k: int,
@@ -183,9 +194,17 @@ def _moe_tokens(params: Dict[str, jnp.ndarray], xt: jnp.ndarray,
     b1 = params["moe_fc_bias"].astype(xt.dtype)
     w2 = params["moe_out_kernel"].astype(xt.dtype)
     b2 = params["moe_out_bias"].astype(xt.dtype)
-    h = jnp.einsum("ech,ehf->ecf", b, w1) + b1[:, None, :]
-    h = jax.nn.gelu(h, approximate=gelu_approx)
-    y = jnp.einsum("ecf,efh->ech", h, w2) + b2[:, None, :]
+    if grouped_gemm_enabled(moe.grouped_gemm):
+        # One Pallas grouped GEMM per projection: grid over experts x
+        # row blocks x col blocks, fp32 MXU accumulation, bias + GELU
+        # fused in-register (ops/grouped_gemm.py). Shard-LOCAL: under
+        # ep > 1 this runs inside the `expert` shard_map scope on the
+        # [E/ep, ...] slices — no collective moves for the kernel.
+        y = grouped_ffn(b, w1, b1, w2, b2, not gelu_approx)
+    else:
+        h = jnp.einsum("ech,ehf->ecf", b, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h, approximate=gelu_approx)
+        y = jnp.einsum("ecf,efh->ech", h, w2) + b2[:, None, :]
 
     if ep > 1:
         # Combine: the inverse regroup + the SAME tiled all-to-all (the

@@ -1,1 +1,3 @@
 from .optimizers import build_optimizer
+from . import autotune
+from .grouped_gemm import grouped_ffn, grouped_gemm_enabled

@@ -71,6 +71,31 @@ def _pick_block_bwd(s: int, causal: bool) -> int:
     return _pick_block(s, _BLOCK_TARGET_BWD or _BLOCK_TARGET)
 
 
+def _block_candidates(s: int):
+    """Legal kernel blocks for a sequence of length s: the power-of-two
+    grid the heuristic targets draw from, each dividing s."""
+    return tuple(b for b in (128, 256, 512, 1024)
+                 if b <= s and s % b == 0) or (s,)
+
+
+def _resolve_blocks(kernel: str, q, k, causal: bool, heur, run_at):
+    """Route a (bq, bk) pick through ops.autotune.  ``run_at(tile)``
+    executes the real kernel pinned to a candidate tile (the measure);
+    DS_AUTOTUNE=0 / CPU return ``heur`` — today's _BLOCK_TARGET
+    heuristics (and their env overrides) bit-for-bit.  fwd and bwd
+    resolve under separate kernel keys: the causal-bwd tile trade (finer
+    blocks skip real compute) is real and shape-dependent."""
+    from . import autotune
+    BH, S, D = q.shape
+    Sk = k.shape[1]
+    cands = [(cq, ck) for cq in _block_candidates(S)
+             for ck in _block_candidates(Sk)]
+    measure = autotune.measure_from_runner(run_at) \
+        if autotune.search_allowed() else None
+    return autotune.resolve(kernel, (BH, S, Sk, D, int(causal)),
+                            str(q.dtype), heur, cands, measure)
+
+
 def _run_pred(causal: bool, qi, kj, bq: int, bk: int, layout_block=None):
     """Static-or-traced predicate for whether a (q,k) block pair runs."""
     conds = []
@@ -287,7 +312,7 @@ def _qkv_spec(blk: int, D: int, role: str):
 
 
 def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
-               dropout: float = 0.0, seed=None):
+               dropout: float = 0.0, seed=None, _blocks=None):
     """q,k,v: [BH, S, D]; layout int32 [H, nQ, nK] or None.
     → (o [BH,S,D], lse [BH,1,S] f32)."""
     BH, S, D = q.shape
@@ -296,8 +321,17 @@ def _flash_fwd(q, k, v, layout, scale: float, causal: bool,
     if has_layout:
         # Kernel blocks must match the layout's block granularity.
         bq = bk = S // layout.shape[-1]
+    elif _blocks is not None:
+        bq, bk = _blocks
     else:
-        bq, bk = _pick_block(S), _pick_block(Sk)
+        def run_at(tile):
+            return _flash_fwd(jnp.zeros((BH, S, D), q.dtype),
+                              jnp.zeros((BH, Sk, D), k.dtype),
+                              jnp.zeros((BH, Sk, D), v.dtype),
+                              None, scale, causal, _blocks=tile)
+        bq, bk = _resolve_blocks(
+            "flash_fwd", q, k, causal,
+            (_pick_block(S), _pick_block(Sk)), run_at)
     grid = (BH, S // bq, Sk // bk)
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
@@ -511,21 +545,33 @@ def _flash_bwd_fused(q, k, v, lse, do, delta, scale, causal, dropout, seed):
 
 
 def _flash_bwd(q, k, v, o, lse, do, layout, scale: float, causal: bool,
-               dropout: float = 0.0, seed=None):
+               dropout: float = 0.0, seed=None, _blocks=None):
     BH, S, D = q.shape
     Sk = k.shape[1]
     has_layout = layout is not None
     if has_layout:
         bq = bk = S // layout.shape[-1]
+    elif _blocks is not None:
+        bq, bk = _blocks
     else:
         bq, bk = _pick_block_bwd(S, causal), _pick_block_bwd(Sk, causal)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True).transpose(0, 2, 1)  # [BH, 1, S]
 
-    if not has_layout and S == Sk and _pick_block(S) == S and \
+    if _blocks is None and not has_layout and S == Sk and \
+            _pick_block(S) == S and \
             os.environ.get("DS_FLASH_FUSED_BWD", "1") == "1":
         return _flash_bwd_fused(q, k, v, lse, do, delta, scale, causal,
                                 dropout, seed)
+
+    if _blocks is None and not has_layout:
+        def run_at(tile):
+            z = lambda s: jnp.zeros(s.shape, s.dtype)  # noqa: E731
+            lse0 = jnp.zeros((BH, 1, S), jnp.float32)
+            return _flash_bwd(z(q), z(k), z(v), z(o), lse0, z(do), None,
+                              scale, causal, _blocks=tile)
+        bq, bk = _resolve_blocks("flash_bwd", q, k, causal, (bq, bk),
+                                 run_at)
 
     dq_specs = [
         _qkv_spec(bq, D, "q"),

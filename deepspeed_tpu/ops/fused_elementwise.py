@@ -68,6 +68,8 @@ try:  # TPU backend bits are importable everywhere; interpret=True on CPU
 except Exception:  # pragma: no cover
     pltpu = None
 
+from . import autotune
+
 _LANE = 128
 _SQRT_2_OVER_PI = math.sqrt(2.0 / math.pi)
 _GELU_C = 0.044715
@@ -93,14 +95,35 @@ def fused_elementwise_enabled(flag="auto") -> bool:
     return jax.default_backend() == "tpu"
 
 
-def _geom(rows: int, H: int, n_bufs: int) -> Tuple[int, int, int]:
+_VMEM_BUDGET = 12 * 2 ** 20
+
+
+def _geom(rows: int, H: int, n_bufs: int, kernel: str = None,
+          dtype=None, runner=None, rb: int = None
+          ) -> Tuple[int, int, int]:
     """(rows_pad, Hpad, rb): lane-pad H to a 128 multiple, pick the
     largest power-of-two row block whose ``n_bufs`` fp32 copies fit a
-    conservative VMEM budget, pad rows to a block multiple."""
+    conservative VMEM budget, pad rows to a block multiple.
+
+    When ``kernel`` is given the row block resolves through
+    ``ops.autotune`` (heuristic = the budget loop below, candidates =
+    powers of two under the same budget); DS_AUTOTUNE=0 and CPU reduce
+    to the heuristic bit-for-bit.  ``rb`` pins the block (the autotune
+    measure runner's recursion guard)."""
     Hpad = -(-H // _LANE) * _LANE
-    rb = 128
-    while rb > 16 and rb * Hpad * 4 * n_bufs > 12 * 2 ** 20:
-        rb //= 2
+    if rb is None:
+        rb = 128
+        while rb > 16 and rb * Hpad * 4 * n_bufs > _VMEM_BUDGET:
+            rb //= 2
+        if kernel is not None:
+            cands = autotune.pow2_candidates(
+                16, 256, lambda c: c * Hpad * 4 * n_bufs <= _VMEM_BUDGET)
+            measure = autotune.measure_from_runner(runner) \
+                if (runner is not None and autotune.search_allowed()) \
+                else None
+            rb = autotune.resolve(kernel, (rows, H, n_bufs),
+                                  str(jnp.dtype(dtype or jnp.float32)),
+                                  rb, cands, measure)
     rows_pad = -(-rows // rb) * rb
     return rows_pad, Hpad, rb
 
@@ -212,13 +235,22 @@ def _ln_bwd_kernel(s_ref, scale_ref, dy_ref, gs_ref, dx_ref, dsc_ref,
     dbi_ref[...] = jnp.sum(dy, axis=0, keepdims=True)
 
 
-def _ln_forward(x, delta, scale, bias, eps: float):
+def _ln_forward(x, delta, scale, bias, eps: float, _rb: int = None):
     """Shared fwd driver: returns (s, y) — s is x when no residual."""
     shape, dtype = x.shape, x.dtype
     H = shape[-1]
     rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
     has_resid = delta is not None
-    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=6 if has_resid else 5)
+
+    def runner(rb_):
+        dx = jnp.zeros((rows, H), dtype)
+        dd = jnp.zeros((rows, H), dtype) if has_resid else None
+        v = jnp.zeros((H,), jnp.float32)
+        return _ln_forward(dx, dd, v, v, eps, _rb=rb_)
+
+    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=6 if has_resid else 5,
+                               kernel="fused_ln_fwd", dtype=dtype,
+                               runner=runner, rb=_rb)
     x2 = _pad2(x.reshape(rows, H), rows_pad, Hpad)
     args = [x2]
     if has_resid:
@@ -247,13 +279,22 @@ def _ln_forward(x, delta, scale, bias, eps: float):
     return x, unpad(outs[0])
 
 
-def _ln_backward(s, scale, dy, gs, eps: float):
+def _ln_backward(s, scale, dy, gs, eps: float, _rb: int = None):
     """Shared bwd driver: (ds, dscale, dbias)."""
     shape, dtype = s.shape, s.dtype
     H = shape[-1]
     rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
     has_gs = gs is not None
-    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=7 if has_gs else 6)
+
+    def runner(rb_):
+        d2 = jnp.zeros((rows, H), dtype)
+        dg = jnp.zeros((rows, H), dtype) if has_gs else None
+        v = jnp.zeros((H,), jnp.float32)
+        return _ln_backward(d2, v, d2, dg, eps, _rb=rb_)
+
+    rows_pad, Hpad, rb = _geom(rows, H, n_bufs=7 if has_gs else 6,
+                               kernel="fused_ln_bwd", dtype=dtype,
+                               runner=runner, rb=_rb)
     grid = rows_pad // rb
     s2 = _pad2(s.reshape(rows, H), rows_pad, Hpad)
     dy2 = _pad2(dy.reshape(rows, H), rows_pad, Hpad)
@@ -375,11 +416,17 @@ def fused_bias_gelu(y, bias, exact: bool = False):
     return _gelu_apply(y, bias, exact)
 
 
-def _gelu_apply(y, bias, exact):
+def _gelu_apply(y, bias, exact, _rb: int = None):
     shape, dtype = y.shape, y.dtype
     F = shape[-1]
     rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
-    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=4)
+
+    def runner(rb_):
+        return _gelu_apply(jnp.zeros((rows, F), dtype),
+                           jnp.zeros((F,), jnp.float32), exact, _rb=rb_)
+
+    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=4, kernel="fused_gelu_fwd",
+                               dtype=dtype, runner=runner, rb=_rb)
     y2 = _pad2(y.reshape(rows, F), rows_pad, Fpad)
     out = pl.pallas_call(
         functools.partial(_gelu_fwd_kernel, exact=exact, out_dtype=dtype),
@@ -398,10 +445,21 @@ def _fbg_fwd(y, bias, exact):
 
 def _fbg_bwd(exact, res, g):
     y, bias = res
+    return _fbg_bwd_impl(y, bias, g, exact)
+
+
+def _fbg_bwd_impl(y, bias, g, exact, _rb: int = None):
     shape, dtype = y.shape, y.dtype
     F = shape[-1]
     rows = int(math.prod(shape[:-1])) if len(shape) > 1 else 1
-    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=5)
+
+    def runner(rb_):
+        z = jnp.zeros((rows, F), dtype)
+        return _fbg_bwd_impl(z, jnp.zeros((F,), jnp.float32), z, exact,
+                             _rb=rb_)
+
+    rows_pad, Fpad, rb = _geom(rows, F, n_bufs=5, kernel="fused_gelu_bwd",
+                               dtype=dtype, runner=runner, rb=_rb)
     grid = rows_pad // rb
     y2 = _pad2(y.reshape(rows, F), rows_pad, Fpad)
     g2 = _pad2(g.reshape(rows, F), rows_pad, Fpad)

@@ -92,6 +92,8 @@ try:  # TPU backend bits are importable everywhere; interpret=True runs on CPU
 except Exception:  # pragma: no cover
     pltpu = None
 
+from . import autotune
+
 ScheduleOrFloat = Union[Callable, float]
 
 # Kernel geometry: W lanes wide (128-multiple), up to _R sublane rows per
@@ -347,20 +349,35 @@ def _chunk_spec(rb: int):
     return pl.BlockSpec((rb, _W), lambda i: (i, 0))
 
 
-def _block_rows(rows: int) -> int:
+def _block_rows(rows: int, kernel: str = None, runner=None) -> int:
     """Largest power-of-two row count <= _R dividing ``rows`` (rows is
-    always a multiple of 8 by the _ROW_QUANTUM padding)."""
+    always a multiple of 8 by the _ROW_QUANTUM padding).  With ``kernel``
+    set, the pick routes through ``ops.autotune`` — candidates are the
+    dividing powers of two up to _R, heuristic the largest (today's
+    choice bit-for-bit under DS_AUTOTUNE=0 / on CPU)."""
     rb = _R
     while rb > 8 and rows % rb:
         rb //= 2
     assert rows % rb == 0, (rows, rb)
+    if kernel is not None:
+        cands = autotune.pow2_candidates(8, _R, lambda c: rows % c == 0)
+        measure = autotune.measure_from_runner(runner) \
+            if (runner is not None and autotune.search_allowed()) else None
+        rb = autotune.resolve(kernel, (rows, _W), "float32", rb, cands,
+                              measure)
+        assert rows % rb == 0, (rows, rb)
     return rb
 
 
-def _run_sqnorm(gflat: jax.Array) -> jax.Array:
+def _run_sqnorm(gflat: jax.Array, _rb: int = None) -> jax.Array:
     """Squared norm of one flat group buffer via per-chunk partials."""
     rows = gflat.size // _W
-    rb = _block_rows(rows)
+
+    def runner(rb_):
+        return _run_sqnorm(jnp.zeros((rows * _W,), gflat.dtype), _rb=rb_)
+
+    rb = _rb or _block_rows(rows, kernel="fused_update_sqnorm",
+                            runner=runner)
     grid = rows // rb
     out = pl.pallas_call(
         _sqnorm_kernel,
@@ -375,11 +392,25 @@ def _run_sqnorm(gflat: jax.Array) -> jax.Array:
 
 def _run_group(gflat, pflat, m, v, scalars, seed, *, b1, b2, eps, wd,
                coupled, use_inv, use_coeff, one_pass, sr, cast,
-               out_dtype, cast_dtype):
+               out_dtype, cast_dtype, _rb: int = None):
     """Run the fused kernel over one flat group buffer (local shard when
     shard-mapped). Returns (p_new, m_new, v_new, cast_new_or_None)."""
     rows = gflat.size // _W
-    rb = _block_rows(rows)
+
+    def runner(rb_):
+        return _run_group(
+            jnp.zeros(gflat.shape, gflat.dtype),
+            jnp.zeros(pflat.shape, pflat.dtype),
+            jnp.zeros(m.shape, m.dtype), jnp.zeros(v.shape, v.dtype),
+            jnp.zeros(scalars.shape, scalars.dtype),
+            jnp.zeros(seed.shape, seed.dtype),
+            b1=b1, b2=b2, eps=eps, wd=wd, coupled=coupled,
+            use_inv=use_inv, use_coeff=use_coeff, one_pass=one_pass,
+            sr=sr, cast=cast, out_dtype=out_dtype,
+            cast_dtype=cast_dtype, _rb=rb_)
+
+    rb = _rb or _block_rows(rows, kernel="fused_update_apply",
+                            runner=runner)
     shape2 = (rows, _W)
     kernel = functools.partial(
         _fused_adam_kernel, b1=b1, b2=b2, eps=eps, wd=wd, coupled=coupled,

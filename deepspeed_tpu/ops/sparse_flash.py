@@ -697,7 +697,29 @@ def sparse_flash_attention(q, k, v, layout, *, causal=False, scale,
     if qwiden == 0:
         qwiden = int(os.environ.get("DS_SPARSE_QWIDEN", "0"))
     if widen == 0 and qwiden == 0:
-        qwiden, widen = pick_tile(lay_np, block=bk)
+        # The cost-model pick routes through ops.autotune: on TPU the
+        # first compile of a (shape, layout) key times the legal
+        # super-tile grid (fwd pass — the bwd kernels share the tiling)
+        # and caches the winner; DS_AUTOTUNE=0 / CPU keep the calibrated
+        # pick_tile model bit-for-bit.
+        from . import autotune
+        heur = pick_tile(lay_np, block=bk)
+        nQ, nK = int(layout.shape[1]), int(layout.shape[2])
+        cands = [(qw, kw) for qw in (1, 2) for kw in (1, 2, 4, 8)
+                 if nQ % qw == 0 and nK % kw == 0 and qw * kw <= 31]
+        measure = None
+        if autotune.search_allowed():
+            def run_at(tile):
+                return sparse_flash_attention(
+                    jnp.zeros((BH, S, D), q.dtype),
+                    jnp.zeros(k.shape, k.dtype),
+                    jnp.zeros(v.shape, v.dtype), lay_np, causal=causal,
+                    scale=scale, qwiden=tile[0], widen=tile[1])
+            measure = autotune.measure_from_runner(run_at)
+        nnz = int((lay_np != 0).sum())
+        qwiden, widen = autotune.resolve(
+            "sparse_flash", (BH, S, D, nH, nQ, nK, nnz, int(causal)),
+            str(q.dtype), heur, cands, measure)
     # Pinning one factor explicitly leaves the other at 1 (not auto):
     # callers sweeping a single dimension get exactly that dimension.
     widen = widen or 1

@@ -409,6 +409,8 @@ class MoeConfig:
                                  C.MOE_Z_LOSS_WEIGHT_DEFAULT)
         self.expert_parallel_size = get(d, C.MOE_EXPERT_PARALLEL_SIZE,
                                         C.MOE_EXPERT_PARALLEL_SIZE_DEFAULT)
+        self.grouped_gemm = get(d, C.MOE_GROUPED_GEMM,
+                                C.MOE_GROUPED_GEMM_DEFAULT)
         self._validate()
 
     def _validate(self) -> None:
@@ -422,6 +424,10 @@ class MoeConfig:
             raise DeepSpeedConfigError(
                 f"{blk}.{C.MOE_EXPERT_PARALLEL_SIZE} must be a positive "
                 f"int, got {self.expert_parallel_size!r}")
+        if self.grouped_gemm not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.MOE_GROUPED_GEMM} must be true/false/"
+                f"\"auto\", got {self.grouped_gemm!r}")
         if self.num_experts == 0:
             if self.expert_parallel_size > 1:
                 raise DeepSpeedConfigError(

@@ -393,7 +393,8 @@ class TestBenchGate:
     def test_extract_metrics_all_shapes(self):
         bg = load_bench_gate()
         none_srv = {"serve_tps": None, "ttft_p95": None,
-                    "kernel_speedup": None, "zero3_overlap": None,
+                    "kernel_speedup": None, "tile_speedup": None,
+                    "zero3_overlap": None,
                     "health": None, "hbm_per_token": None,
                     "accept_rate": None, "moe_drop": None,
                     "dcn_bytes": None, "ckpt_share": None,
@@ -528,6 +529,25 @@ class TestBenchGate:
         old = self._write(tmp_path, "old.json", {"mfu": 0.50})
         new = self._write(tmp_path, "new.json", {"mfu": 0.40})
         assert bg.main([old, new, "--mfu-drop", "0.10"]) == 1
+
+    def test_gate_tile_speedup(self, tmp_path):
+        """--tile-drop gates kernels.tile_speedup (ablate_autotune.py);
+        pre-autotune rounds skip, never fail."""
+        bg = load_bench_gate()
+        old = self._write(tmp_path, "old.json",
+                          {"kernels": {"tile_speedup": 1.20}})
+        bad = self._write(tmp_path, "bad.json",
+                          {"kernels": {"tile_speedup": 1.00}})
+        ok = self._write(tmp_path, "ok.json",
+                         {"kernels": {"tile_speedup": 1.15}})
+        pre = self._write(tmp_path, "pre.json", {"mfu": 0.5})
+        assert bg.extract_metrics(
+            {"kernels": {"tile_speedup": 1.2}})["tile_speedup"] == 1.2
+        assert bg.main([old, ok, "--tile-drop", "0.10"]) == 0
+        assert bg.main([old, bad, "--tile-drop", "0.10"]) == 1
+        assert bg.main([old, bad, "--tile-drop", "0.20"]) == 0
+        # Pre-autotune rounds on either side: skipped, never failed.
+        assert bg.main([pre, pre]) == 0
 
     def test_gate_fails_on_goodput_regression(self, tmp_path):
         bg = load_bench_gate()

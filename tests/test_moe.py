@@ -87,7 +87,8 @@ def moe_ds_config(moe: MoEConfig, stage=2, lr=3e-3, gas=1, **extra):
                 "capacity_factor": moe.capacity_factor,
                 "aux_loss_weight": moe.aux_loss_weight,
                 "z_loss_weight": moe.z_loss_weight,
-                "expert_parallel_size": moe.expert_parallel_size},
+                "expert_parallel_size": moe.expert_parallel_size,
+                "grouped_gemm": moe.grouped_gemm},
         "steps_per_print": 10 ** 9,
     }
     cfg.update(extra)
@@ -565,6 +566,138 @@ def test_bench_gate_moe_drop_extraction():
     assert m["moe_drop"] == 0.07
     # Pre-MoE rounds carry nothing -> None -> the gate skips.
     assert bg.extract_metrics({"mfu": 0.5})["moe_drop"] is None
+
+
+# --------------------------------------------------------------------- #
+# Grouped-GEMM expert kernel (ops/grouped_gemm) vs the einsum pair
+# --------------------------------------------------------------------- #
+class TestGroupedGEMM:
+    """One Pallas kernel over [E,C,H]x[E,H,F] replaces the two einsums in
+    ``_moe_tokens`` — cfg-static dispatch mirroring fused_kernels.
+
+    Numerics tiers are the fused-elementwise contract: fp32 within a few
+    f32 ulp (cross-program MXU accumulation association is the residue —
+    the PR-1 limit), bf16 within ~2 bf16 ulp (the kernel rounds ONCE per
+    stage where the einsum chain rounds per op)."""
+
+    def _ffn_ref(self, x, w1, b1, w2, b2, exact):
+        h = jnp.einsum("ech,ehf->ecf", x, w1) + b1[:, None, :]
+        h = jax.nn.gelu(h, approximate=not exact)
+        return jnp.einsum("ecf,efh->ech", h, w2) + b2[:, None, :]
+
+    def _mats(self, E, C, H, F, dtype, seed=0):
+        r = np.random.default_rng(seed)
+        def t(shape, scale=1.0):
+            return jnp.asarray(r.standard_normal(shape) * scale,
+                               jnp.float32).astype(dtype)
+        return (t((E, C, H)), t((E, H, F), H ** -0.5), t((F,)),
+                t((E, F, H), F ** -0.5), t((H,)))
+
+    @pytest.mark.parametrize("dtype,exact", [
+        (jnp.float32, False), (jnp.float32, True), (jnp.bfloat16, False)])
+    def test_kernel_matches_einsum_fwd_and_bwd(self, dtype, exact):
+        from deepspeed_tpu.ops.grouped_gemm import grouped_ffn
+        rtol, atol = ((0.05, 0.05) if dtype == jnp.bfloat16
+                      else (1e-5, 1e-6))
+        x, w1, b1, w2, b2 = self._mats(4, 48, 96, 160, dtype)
+        b1e, b2e = b1[None, :].repeat(4, 0), b2[None, :].repeat(4, 0)
+        y_k = grouped_ffn(x, w1, b1e, w2, b2e, exact)
+        y_r = self._ffn_ref(x, w1, b1e, w2, b2e, exact)
+        assert y_k.dtype == dtype
+        np.testing.assert_allclose(np.asarray(y_k, np.float32),
+                                   np.asarray(y_r, np.float32),
+                                   rtol=rtol, atol=atol)
+
+        def loss_k(*a):
+            return jnp.sum(grouped_ffn(*a, exact).astype(jnp.float32) ** 2)
+
+        def loss_r(*a):
+            return jnp.sum(self._ffn_ref(*a, exact)
+                           .astype(jnp.float32) ** 2)
+
+        gk = jax.grad(loss_k, argnums=(0, 1, 2, 3, 4))(x, w1, b1e, w2, b2e)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2, 3, 4))(x, w1, b1e, w2, b2e)
+        # Gradients compound one more matmul; scale atol to grad magnitude.
+        for a, b in zip(gk, gr):
+            bound = atol * max(1.0, float(jnp.max(jnp.abs(
+                b.astype(jnp.float32)))))
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(b, np.float32),
+                                       rtol=rtol, atol=bound)
+
+    def test_single_expert_kernel_matches_dense_ffn(self):
+        """num_experts=1 with the kernel FORCED on: the grouped FFN is the
+        dense FFN to ulp class (bit-parity is the default path's property
+        — 'auto' keeps the einsum on CPU, covered by TestDenseParity)."""
+        from deepspeed_tpu.ops.grouped_gemm import grouped_ffn
+        x, w1, b1, w2, b2 = self._mats(1, 64, 96, 160, jnp.float32, seed=3)
+        y_k = grouped_ffn(x, w1, b1[None], w2, b2[None], False)
+        h = jax.nn.gelu(x[0] @ w1[0] + b1, approximate=True)
+        y_d = h @ w2[0] + b2
+        np.testing.assert_allclose(np.asarray(y_k[0]), np.asarray(y_d),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_dispatch_is_cfg_static(self, monkeypatch):
+        from deepspeed_tpu.ops.grouped_gemm import grouped_gemm_enabled
+        monkeypatch.delenv("DS_GROUPED_GEMM", raising=False)
+        assert grouped_gemm_enabled(True) is True
+        assert grouped_gemm_enabled(False) is False
+        # "auto" follows the backend (TPU on / CPU off) ...
+        assert grouped_gemm_enabled("auto") == \
+            (jax.default_backend() == "tpu")
+        # ... and the env override wins over "auto" only.
+        monkeypatch.setenv("DS_GROUPED_GEMM", "1")
+        assert grouped_gemm_enabled("auto") is True
+        assert grouped_gemm_enabled(False) is False
+        monkeypatch.setenv("DS_GROUPED_GEMM", "0")
+        assert grouped_gemm_enabled("auto") is False
+        assert grouped_gemm_enabled(True) is True
+
+    def test_engine_step_grouped_on_vs_off(self):
+        """ep=4 x dp=2 engine: one train step with the kernel forced on
+        matches the einsum path at fp32 tolerance (shard-local under the
+        expert shard_map — no new collectives, same routing)."""
+        losses = {}
+        for knob in (False, True):
+            engine, _, _ = build_engine(moe8(ep=4, grouped_gemm=knob),
+                                        stage=2)
+            b = np.random.default_rng(7).integers(
+                0, VOCAB, size=(32, S + 1)).astype(np.int32)
+            losses[knob] = float(jax.device_get(engine.train_batch(b)))
+        assert np.isfinite(list(losses.values())).all()
+        np.testing.assert_allclose(losses[True], losses[False],
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_checkpoint_roundtrip_across_knob(self, tmp_path):
+        """Resume-compatibility: the knob changes the schedule, not the
+        state tree — a checkpoint written with the einsum path loads and
+        trains under the kernel (the PR-8 fused_kernels precedent)."""
+        engine, _, _ = build_engine(moe8(ep=4, grouped_gemm=False),
+                                    stage=2)
+        rng = np.random.default_rng(0)
+        batch = rng.integers(0, VOCAB, size=(32, S + 1)).astype(np.int32)
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path), tag="knob")
+        want = jax.device_get(engine.state.params)
+
+        engine2, *_ = build_engine(moe8(ep=4, grouped_gemm=True),
+                                   stage=2, seed=1)
+        engine2.load_checkpoint(str(tmp_path), tag="knob")
+        jax.tree_util.tree_map(np.testing.assert_array_equal, want,
+                               jax.device_get(engine2.state.params))
+        assert np.isfinite(float(jax.device_get(
+            engine2.train_batch(batch))))
+
+    def test_moe_config_grouped_gemm_validation(self):
+        from deepspeed_tpu.runtime.config import (DeepSpeedConfig,
+                                                  DeepSpeedConfigError)
+        base = moe_ds_config(moe8(ep=4))
+        base["moe"]["grouped_gemm"] = "sometimes"
+        with pytest.raises(DeepSpeedConfigError, match="grouped_gemm"):
+            DeepSpeedConfig(base)
+        for ok in (True, False, "auto"):
+            base["moe"]["grouped_gemm"] = ok
+            assert DeepSpeedConfig(base).moe_config.grouped_gemm == ok
 
 
 # --------------------------------------------------------------------- #

@@ -28,7 +28,11 @@ tokens/s regresses on a relative drop beyond ``--serve-drop`` (default
 step changes, not jitter); the fused-kernel ablation speedup (the
 ``kernels.fused_speedup`` field a DS_BENCH_KERNELS=1 bench or
 ``ablate_fused_ln.py`` records) regresses on a relative drop beyond
-``--kernel-drop`` (default 10%); the ZeRO-3 prefetch overlap fraction
+``--kernel-drop`` (default 10%); the autotuned-tile speedup (the
+``kernels.tile_speedup`` field ``ablate_autotune.py --record`` writes
+— geomean of the per-kernel winner-over-heuristic ratios) regresses on
+a relative drop beyond ``--tile-drop`` (default 10%), and pre-autotune
+rounds skip, never fail; the ZeRO-3 prefetch overlap fraction
 (``zero3.overlap_fraction`` from ablate_zero3_prefetch.py's
 ZERO3_BENCH.json) regresses on the same relative threshold. Paged-cache
 serving rounds additionally gate ``serving.hbm_bytes_per_token`` (p50;
@@ -102,6 +106,12 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     krn = doc.get("kernels")
     if isinstance(krn, dict) and krn.get("fused_speedup") is not None:
         kernel_speedup = float(krn["fused_speedup"])
+    # Autotune ablation record (ablate_autotune.py): geomean step-level
+    # speedup of the autotuned tiles over the static heuristics.
+    # Pre-autotune rounds carry no field -> skipped, never failed.
+    tile_speedup: Optional[float] = None
+    if isinstance(krn, dict) and krn.get("tile_speedup") is not None:
+        tile_speedup = float(krn["tile_speedup"])
     # TELEMETRY.json shape: structured mfu/goodput sections.
     if isinstance(doc.get("mfu"), dict):
         sec = doc["mfu"]
@@ -200,6 +210,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         }
     return {"mfu": mfu, "goodput": goodput, "serve_tps": serve_tps,
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
+            "tile_speedup": tile_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
             "moe_drop": moe_drop, "dcn_bytes": dcn_bytes,
@@ -229,7 +240,7 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
          ttft_rise: float = 0.25, kernel_drop: float = 0.10,
          hbm_rise: float = 0.15, accept_floor: float = 0.05,
          moe_drop_rise: float = 0.05, dcn_rise: float = 0.10,
-         ckpt_share_max: float = 0.05) -> int:
+         ckpt_share_max: float = 0.05, tile_drop: float = 0.10) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -313,6 +324,24 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         missing = [n for n, m in ((name_old, old), (name_new, new))
                    if m["kernel_speedup"] is None]
         print(f"kernel fused speedup: skipped (no kernels record in "
+              f"{', '.join(missing)})")
+
+    if old["tile_speedup"] is not None and \
+            new["tile_speedup"] is not None:
+        compared += 1
+        floor = old["tile_speedup"] * (1.0 - tile_drop)
+        verdict = "OK" if new["tile_speedup"] >= floor else "REGRESSION"
+        print(f"autotune tile speedup: {name_old}="
+              f"{old['tile_speedup']:.4g}x -> "
+              f"{name_new}={new['tile_speedup']:.4g}x "
+              f"(floor {floor:.4g}x, -{tile_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-autotune rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["tile_speedup"] is None]
+        print(f"autotune tile speedup: skipped (no tile record in "
               f"{', '.join(missing)})")
 
     if old["hbm_per_token"] is not None and \
@@ -480,6 +509,9 @@ def main(argv=None) -> int:
     ap.add_argument("--kernel-drop", type=float, default=0.10,
                     help="max tolerated RELATIVE drop of the fused-"
                          "kernel speedup (default 0.10)")
+    ap.add_argument("--tile-drop", type=float, default=0.10,
+                    help="max tolerated RELATIVE drop of the autotuned-"
+                         "tile speedup vs heuristics (default 0.10)")
     ap.add_argument("--hbm-rise", type=float, default=0.15,
                     help="max tolerated RELATIVE rise of serving HBM "
                          "bytes per cached token (default 0.15)")
@@ -512,7 +544,8 @@ def main(argv=None) -> int:
         return gate(old_path, new_path, args.mfu_drop, args.goodput_drop,
                     args.serve_drop, args.ttft_rise, args.kernel_drop,
                     args.hbm_rise, args.accept_floor, args.moe_drop_rise,
-                    args.dcn_rise, args.ckpt_share_max)
+                    args.dcn_rise, args.ckpt_share_max,
+                    tile_drop=args.tile_drop)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -231,6 +231,10 @@ def build_moe():
     # materialization of expert state, and collective_placement's
     # expert check proves no expert grad ever all-reduces ACROSS the
     # expert axis (its seeded violation lives in tests/test_moe.py).
+    # grouped_gemm=True runs the expert FFN through the Pallas grouped
+    # kernel (interpret-mode here), so materialization/dtype_flow also
+    # gate the kernel path: the recompute-not-save VJP must keep the
+    # [E,C,F] fp32 pre-activation out of the held residual set.
     import dataclasses
     from deepspeed_tpu.models.gpt2 import (GPT2_CONFIGS, gpt2_init,
                                            gpt2_loss_fn)
@@ -240,7 +244,7 @@ def build_moe():
     ep, E = 4, 8
     mesh = build_mesh(ep=ep)
     moe = MoEConfig(num_experts=E, top_k=2, capacity_factor=1.5,
-                    expert_parallel_size=ep)
+                    expert_parallel_size=ep, grouped_gemm=True)
     cfg = dataclasses.replace(
         GPT2_CONFIGS["gpt2-tiny"], vocab_size=64, max_seq_length=33,
         hidden_dropout=0.0, attn_dropout=0.0, dtype=jnp.float32,
@@ -253,7 +257,8 @@ def build_moe():
               "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
               "moe": {"num_experts": E, "top_k": 2,
                       "capacity_factor": 1.5,
-                      "expert_parallel_size": ep},
+                      "expert_parallel_size": ep,
+                      "grouped_gemm": True},
               "steps_per_print": 10 ** 9, "telemetry": _tel("moe")}
     engine, *_ = deepspeed_tpu.initialize(
         model=gpt2_loss_fn(cfg, mesh=mesh),
