@@ -59,10 +59,17 @@ def materialization_pass(ctx: LintContext) -> List[LintFinding]:
     # prefetch_depth+1 layers on the scan path) — peak live buffers must
     # stay under declared per-device state + that bound, NEVER the full
     # fp32 master tree (the stage-3 correctness gate; a concat of
-    # gathered leaves into one tree-scale buffer still fires).
+    # gathered leaves into one tree-scale buffer still fires). Paged
+    # serving engines running the ONE-HOT attend similarly budget their
+    # fp32 score transient (``paged_score_bytes``: [G, Q, K, nH, B, bs]
+    # per layer — it scales with pool capacity, so pool growth alone
+    # must not blow the watermark); a full-pool K/V GATHER is head_dim
+    # times bigger and still fires. Kernel-on engines declare 0 — the
+    # transient must not exist at all.
     thresh = max(int(ctx.config.materialize_floor_bytes),
                  int(ctx.config.materialize_fraction * declared)
-                 + int(ctx.meta.get("zero3_gather_bytes") or 0),
+                 + int(ctx.meta.get("zero3_gather_bytes") or 0)
+                 + int(ctx.meta.get("paged_score_bytes") or 0),
                  int(ctx.meta.get("largest_leaf_bytes") or 0))
     # Aggregate by largest-buffer SHAPE: one oversized buffer flows
     # through many opcodes (broadcast -> fusion -> copy -> ...); the

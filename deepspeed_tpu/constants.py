@@ -339,6 +339,16 @@ INFERENCE_KV_DTYPE_MODES = ("model", "bf16")
 # percentile streams apart.
 INFERENCE_REPLICA = "replica"
 INFERENCE_REPLICA_DEFAULT = ""
+# Pallas paged-attention kernel for the paged decode/verify/prefill
+# attends (ops/paged_attention.py): table-driven block slices do
+# O(context) work instead of the one-hot contraction's O(pool). True /
+# False force it; "auto" enables on TPU only (the DS_PAGED_KERNEL env
+# var overrides "auto"). Forced on without a TPU the kernel runs in
+# interpret mode — same program, pure XLA — which is how the CPU-mesh
+# tier-1 proves logit parity. Ignored by slot-major engines
+# (block_size == 0).
+INFERENCE_PAGED_KERNEL = "paged_kernel"
+INFERENCE_PAGED_KERNEL_DEFAULT = "auto"
 
 #############################################
 # ZeRO

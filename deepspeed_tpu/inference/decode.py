@@ -50,6 +50,7 @@ from jax import lax
 
 from . import kv_cache
 from ..models.gpt2 import GPT2Config
+from ..ops import paged_attention as paged_attn_ops
 from ..models.transformer import (dense, gelu_dense_fn, layer_norm,
                                   layer_norm_fn)
 
@@ -259,14 +260,19 @@ def _group_shape(arr: jax.Array, num_groups: int) -> jax.Array:
 
 def _paged_attn_block(p, x, kc, vc, bt_g, cfg: GPT2Config,
                       num_groups: int, write_pos: jax.Array,
-                      pos_mask: jax.Array, sel: jax.Array):
+                      pos_g: jax.Array, sel, pos_mask,
+                      paged_kernel: bool = False, mesh=None):
     """Shared attention step of the paged decode/verify/prefill paths.
 
     x: [S, K, H] — K tokens for each of S per-slot query streams, with
     S = G * Sg (Sg = 1 stream per group for prefill); kc/vc: one
     layer's [G, B, nH, bs, D]; bt_g: [G, Sg, J]; write_pos: [G, Sg*K]
-    token positions to write; pos_mask: [G, Sg, K, J*bs]; sel:
-    [G, Sg, J, B]. Returns (x', kc', vc').
+    token positions to write; pos_g: [G, Sg, K] inclusive last
+    attendable position per query row. ``sel`` [G, Sg, J, B] /
+    ``pos_mask`` [G, Sg, K, J*bs] drive the one-hot baseline and are
+    None when ``paged_kernel`` routes the attend through the Pallas
+    kernel (the writes stay one-hot either way — they are O(written
+    rows), not O(pool)). Returns (x', kc', vc').
     """
     S, K, H = x.shape
     G = num_groups
@@ -281,8 +287,14 @@ def _paged_attn_block(p, x, kc, vc, bt_g, cfg: GPT2Config,
     blk, off = kv_cache.positions_to_blocks(bt_rows, write_pos, bs)
     kc = kv_cache.paged_write_rows(kc, k.reshape(G, R, nH, D), blk, off)
     vc = kv_cache.paged_write_rows(vc, v.reshape(G, R, nH, D), blk, off)
-    attn = kv_cache.paged_attend(q.reshape(G, Sg, K, nH, D), kc, vc, sel,
-                                 pos_mask, 1.0 / math.sqrt(D), NEG_INF)
+    if paged_kernel:
+        attn = paged_attn_ops.paged_attention(
+            q.reshape(G, Sg, K, nH, D), kc, vc, bt_g, pos_g,
+            scale=1.0 / math.sqrt(D), mesh=mesh)
+    else:
+        attn = kv_cache.paged_attend(q.reshape(G, Sg, K, nH, D), kc, vc,
+                                     sel, pos_mask, 1.0 / math.sqrt(D),
+                                     NEG_INF)
     attn = attn.reshape(S, K, H).astype(x.dtype)
     x = x + dense(attn, p["proj_kernel"], p["proj_bias"])
     return _ffn(p, x, cfg), kc, vc
@@ -291,7 +303,8 @@ def _paged_attn_block(p, x, kc, vc, bt_g, cfg: GPT2Config,
 def gpt2_verify_paged(params: Dict[str, Any], kc: jax.Array,
                       vc: jax.Array, tokens: jax.Array,
                       lengths: jax.Array, block_tables: jax.Array,
-                      cfg: GPT2Config, num_groups: int
+                      cfg: GPT2Config, num_groups: int,
+                      paged_kernel: bool = False, mesh=None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The speculative verify step — and, at K=1, plain paged decode.
 
@@ -301,7 +314,9 @@ def gpt2_verify_paged(params: Dict[str, Any], kc: jax.Array,
     attends each under its own causal row, and returns fp32 logits
     [S, K, V] (the K-bounded spec-decode analogue of last-position-only
     logits — never a [max_len, vocab] tensor). kc/vc: the full pool
-    [L, G, B, nH, bs, D].
+    [L, G, B, nH, bs, D]. ``paged_kernel`` swaps the one-hot pool
+    contraction for the Pallas table-sliced kernel (ops/
+    paged_attention.py) — same logits, O(context) work.
     """
     _check_cfg(cfg)
     S, K = tokens.shape
@@ -313,16 +328,19 @@ def gpt2_verify_paged(params: Dict[str, Any], kc: jax.Array,
     x = params["wte"].astype(cfg.dtype)[tokens] + \
         params["wpe"].astype(cfg.dtype)[pos]
     bt_g = _group_shape(block_tables, G)             # [G, Sg, J]
-    sel = kv_cache.block_select(bt_g, kc.shape[2])
     pos_g = _group_shape(pos, G)                     # [G, Sg, K]
-    grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
-    pos_mask = grid <= pos_g[..., None]              # [G, Sg, K, J*bs]
+    sel = pos_mask = None
+    if not paged_kernel:
+        sel = kv_cache.block_select(bt_g, kc.shape[2])
+        grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
+        pos_mask = grid <= pos_g[..., None]          # [G, Sg, K, J*bs]
     write_pos = pos_g.reshape(G, Sg * K)
 
     def body(h, layer):
         p, kcl, vcl = layer
         h, kcl, vcl = _paged_attn_block(p, h, kcl, vcl, bt_g, cfg, G,
-                                        write_pos, pos_mask, sel)
+                                        write_pos, pos_g, sel, pos_mask,
+                                        paged_kernel, mesh)
         return h, (kcl, vcl)
 
     x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))
@@ -334,14 +352,15 @@ def gpt2_verify_paged(params: Dict[str, Any], kc: jax.Array,
 def gpt2_decode_paged(params: Dict[str, Any], kc: jax.Array,
                       vc: jax.Array, tokens: jax.Array,
                       lengths: jax.Array, block_tables: jax.Array,
-                      cfg: GPT2Config, num_groups: int
+                      cfg: GPT2Config, num_groups: int,
+                      paged_kernel: bool = False, mesh=None
                       ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """One paged decode step for every slot: the K=1 verify. Returns
     (logits [S, V] fp32, kc', vc') — same contract as ``gpt2_decode``
     with the block table standing in for the slot-major rows."""
     logits, kc, vc = gpt2_verify_paged(params, kc, vc, tokens[:, None],
                                        lengths, block_tables, cfg,
-                                       num_groups)
+                                       num_groups, paged_kernel, mesh)
     return logits[:, 0], kc, vc
 
 
@@ -349,7 +368,8 @@ def gpt2_prefill_chunk_paged(params: Dict[str, Any], kc: jax.Array,
                              vc: jax.Array, tokens: jax.Array,
                              bt_rows: jax.Array, start: jax.Array,
                              last_idx: jax.Array, active: jax.Array,
-                             cfg: GPT2Config
+                             cfg: GPT2Config,
+                             paged_kernel: bool = False, mesh=None
                              ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Group-batched chunked prefill: one prompt chunk for ONE slot per
     group (the paged twin of ``gpt2_prefill_chunk``).
@@ -372,15 +392,19 @@ def gpt2_prefill_chunk_paged(params: Dict[str, Any], kc: jax.Array,
         params["wpe"].astype(cfg.dtype)[pos]         # [G, C, H]
     bt_g = jnp.where(active[:, None, None] > 0, bt_rows[:, None],
                      kv_cache.DEAD_BLOCK)            # [G, 1, J]
-    sel = kv_cache.block_select(bt_g, kc.shape[2])
-    grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
-    pos_mask = grid <= pos[:, None, :, None]         # [G, 1, C, J*bs]
+    pos_g = pos[:, None, :]                          # [G, 1, C]
+    sel = pos_mask = None
+    if not paged_kernel:
+        sel = kv_cache.block_select(bt_g, kc.shape[2])
+        grid = lax.broadcasted_iota(jnp.int32, (1, 1, 1, J * bs), 3)
+        pos_mask = grid <= pos[:, None, :, None]     # [G, 1, C, J*bs]
     write_pos = pos                                  # [G, C]
 
     def body(h, layer):
         p, kcl, vcl = layer
         h, kcl, vcl = _paged_attn_block(p, h, kcl, vcl, bt_g, cfg, G,
-                                        write_pos, pos_mask, sel)
+                                        write_pos, pos_g, sel, pos_mask,
+                                        paged_kernel, mesh)
         return h, (kcl, vcl)
 
     x, (kc, vc) = lax.scan(body, x, (params["blocks"], kc, vc))

@@ -61,6 +61,18 @@ class ServingAggregator:
         self.cached_tokens_admitted = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
+        # Analytic attend-work accounting (engine-fed, paged engines
+        # only): the same iterations priced BOTH ways — the Pallas
+        # kernel's live-context term vs the one-hot contraction's
+        # pool-capacity term. ``attend_mode`` names which one actually
+        # ran; the totals are host arithmetic (projections), never
+        # device measurements.
+        self.attend_mode: Optional[str] = None
+        self.attend_flops_kernel = 0
+        self.attend_flops_onehot = 0
+        self.attend_bytes_kernel = 0
+        self.attend_bytes_onehot = 0
+        self.attend_tokens = 0
         self._occupancy: List[float] = []
         self._decode_ms: List[float] = []
         self._ttft_ms: List[float] = []
@@ -100,6 +112,18 @@ class ServingAggregator:
     def note_spec(self, proposed: int, accepted: int) -> None:
         self.spec_proposed += int(proposed)
         self.spec_accepted += int(accepted)
+
+    def note_attend(self, flops_kernel: int, flops_onehot: int,
+                    bytes_kernel: int, bytes_onehot: int,
+                    tokens: int) -> None:
+        """One iteration's analytic attend work, both ways (see
+        InferenceEngine._attend_work); ``tokens`` are the iteration's
+        emitted tokens — the per-token denominators."""
+        self.attend_flops_kernel += int(flops_kernel)
+        self.attend_flops_onehot += int(flops_onehot)
+        self.attend_bytes_kernel += int(bytes_kernel)
+        self.attend_bytes_onehot += int(bytes_onehot)
+        self.attend_tokens += int(tokens)
 
     # ---- per completed request ---- #
     def note_request(self, ttft_s: float, tpot_s: Optional[float],
@@ -159,6 +183,26 @@ class ServingAggregator:
                 "acceptance_rate": round(self.spec_accepted /
                                          self.spec_proposed, 4),
             }
+        if self.attend_tokens:
+            t = self.attend_tokens
+            snap["attend"] = {
+                "mode": self.attend_mode or "onehot",
+                "flops_per_token": {
+                    "kernel": round(self.attend_flops_kernel / t, 1),
+                    "onehot": round(self.attend_flops_onehot / t, 1)},
+                "hbm_bytes_per_token": {
+                    "kernel": round(self.attend_bytes_kernel / t, 1),
+                    "onehot": round(self.attend_bytes_onehot / t, 1)},
+                "projection": "analytic (host-priced, not a device "
+                              "measurement)",
+            }
+            if self.attend_bytes_kernel:
+                # The structural headline: one-hot HBM traffic over the
+                # kernel's, same iterations — >1 means the pool
+                # outweighs the live contexts it served.
+                snap["attend_work_ratio"] = round(
+                    self.attend_bytes_onehot / self.attend_bytes_kernel,
+                    4)
         return snap
 
     @classmethod
@@ -176,6 +220,13 @@ class ServingAggregator:
             out.cached_tokens_admitted += a.cached_tokens_admitted
             out.spec_proposed += a.spec_proposed
             out.spec_accepted += a.spec_accepted
+            out.attend_flops_kernel += a.attend_flops_kernel
+            out.attend_flops_onehot += a.attend_flops_onehot
+            out.attend_bytes_kernel += a.attend_bytes_kernel
+            out.attend_bytes_onehot += a.attend_bytes_onehot
+            out.attend_tokens += a.attend_tokens
+            if out.attend_mode is None:
+                out.attend_mode = a.attend_mode
             # Occupancy normalizes per-replica (active/its own slots):
             # pooling the normalized samples keeps the mean meaningful
             # as "fraction of owned capacity busy".

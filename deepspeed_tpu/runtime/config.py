@@ -330,6 +330,8 @@ class InferenceConfig:
                                   C.INFERENCE_KV_DTYPE_DEFAULT)
         self.replica = get(d, C.INFERENCE_REPLICA,
                            C.INFERENCE_REPLICA_DEFAULT)
+        self.paged_kernel = get(d, C.INFERENCE_PAGED_KERNEL,
+                                C.INFERENCE_PAGED_KERNEL_DEFAULT)
         self._validate()
 
     def _validate(self) -> None:
@@ -384,6 +386,10 @@ class InferenceConfig:
             raise DeepSpeedConfigError(
                 f"{C.INFERENCE}.{C.INFERENCE_REPLICA} must be a string "
                 f"label, got {self.replica!r}")
+        if self.paged_kernel not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_PAGED_KERNEL} must be true, "
+                f"false, or \"auto\", got {self.paged_kernel!r}")
 
 
 class MoeConfig:

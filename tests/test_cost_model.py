@@ -398,7 +398,7 @@ class TestBenchGate:
                     "health": None, "hbm_per_token": None,
                     "accept_rate": None, "moe_drop": None,
                     "dcn_bytes": None, "ckpt_share": None,
-                    "ckpt_every": None}
+                    "ckpt_every": None, "attend_ratio": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}
@@ -548,6 +548,31 @@ class TestBenchGate:
         assert bg.main([old, bad, "--tile-drop", "0.20"]) == 0
         # Pre-autotune rounds on either side: skipped, never failed.
         assert bg.main([pre, pre]) == 0
+
+    def test_gate_attend_work_ratio(self, tmp_path):
+        """--attend-drop gates serving.attend_work_ratio (the paged-
+        attention kernel's structural one-hot/kernel HBM ratio — a DROP
+        means decode attend work crept back toward pool capacity);
+        pre-kernel rounds on either side skip, never fail."""
+        bg = load_bench_gate()
+
+        def srv(ratio):
+            return {"serving": {"attend_work_ratio": ratio,
+                                "tokens_per_s": 50.0}}
+
+        old = self._write(tmp_path, "old.json", srv(3.5))
+        ok = self._write(tmp_path, "ok.json", srv(3.3))
+        bad = self._write(tmp_path, "bad.json", srv(2.0))
+        pre = self._write(tmp_path, "pre.json",
+                          {"serving": {"tokens_per_s": 50.0}})
+        assert bg.extract_metrics(srv(3.5))["attend_ratio"] == 3.5
+        assert bg.extract_metrics(
+            {"serving": {"tokens_per_s": 1.0}})["attend_ratio"] is None
+        assert bg.main([old, ok]) == 0
+        assert bg.main([old, bad]) == 1
+        assert bg.main([old, bad, "--attend-drop", "0.60"]) == 0
+        assert bg.main([pre, old]) == 0        # pre-kernel old side
+        assert bg.main([old, pre]) == 0        # pre-kernel new side
 
     def test_gate_fails_on_goodput_regression(self, tmp_path):
         bg = load_bench_gate()

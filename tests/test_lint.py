@@ -108,6 +108,60 @@ class TestSeededViolations:
         assert all(f.bytes >= 2 * 1024 * 4 for f in res.findings)
         assert all(f.priced is False for f in res.findings)
 
+    def test_full_pool_gather_in_serving_path_fires_despite_score_budget(
+            self):
+        """A serving-shaped path that materializes a per-stream copy of
+        the WHOLE block pool ([Q, B, nH, bs, D] — the naive gather the
+        one-hot contraction exists to avoid): fires even though the
+        engine's ``paged_score_bytes`` budget is declared, because a
+        K/V gather is head_dim times the budgeted score transient."""
+        B, nH, bs, D, Q, J, K = 32, 2, 8, 16, 4, 4, 1
+        pool_k = jnp.ones((B, nH, bs, D), jnp.float32)
+        sel = jnp.zeros((Q, J, B), jnp.float32)
+        meta = {"declared_state_bytes": 4096,
+                "largest_leaf_bytes": 2048,
+                "paged_score_bytes": Q * K * nH * B * bs * 4}
+
+        def full_pool_gather(pool_k, sel):
+            gathered = pool_k[None] * sel.sum(1)[:, :, None, None, None]
+            return gathered * 2.0           # live pool-sized value
+
+        res = lint_jit(jax.jit(full_pool_gather), pool_k, sel,
+                       name="seeded_pool_gather", meta=meta)
+        assert not res.errors, res.errors
+        assert _lints(res) == ["materialization"], \
+            [f.fingerprint for f in res.findings]
+        assert all(f.bytes >= Q * B * nH * bs * D * 4
+                   for f in res.findings)
+
+    def test_onehot_score_transient_rides_its_declared_budget(self):
+        """The flip side: the one-hot attend's [Q, K, nH, B, bs] fp32
+        score transient passes WITH the ``paged_score_bytes`` budget the
+        engine declares on one-hot paths, and fires WITHOUT it — the
+        budget is load-bearing, not decorative."""
+        # Q*K > D so the [Q,K,nH,B,bs] score transient outweighs the
+        # declared pool (the regime the budget exists for: pool growth
+        # and wide verify batches inflate the transient past state).
+        B, nH, bs, D, Q, K = 32, 2, 8, 4, 8, 2
+        q = jnp.ones((Q, K, nH, D), jnp.float32)
+        pool_k = jnp.ones((B, nH, bs, D), jnp.float32)
+
+        def score(q, pool_k):
+            s = jnp.einsum("qknd,bntd->qknbt", q, pool_k)
+            return s * 2.0                  # live score-sized value
+
+        pool_bytes = B * nH * bs * D * 4
+        base = {"declared_state_bytes": 2 * pool_bytes,   # K + V pools
+                "largest_leaf_bytes": pool_bytes}
+        budget = Q * K * nH * B * bs * 4
+        clean = lint_jit(jax.jit(score), q, pool_k, name="seeded_score",
+                         meta={**base, "paged_score_bytes": budget})
+        assert not clean.errors and not clean.findings, \
+            [f.fingerprint for f in clean.findings]
+        fires = lint_jit(jax.jit(score), q, pool_k,
+                         name="seeded_score_nobudget", meta=base)
+        assert _lints(fires) == ["materialization"]
+
     def test_bf16_f32_round_trip_caught_by_dtype_flow(self):
         def loss(x):
             wide = x.astype(jnp.float32)          # forced upcast...

@@ -1,0 +1,327 @@
+"""Pallas paged-attention kernel (PR-17 tentpole).
+
+The load-bearing invariants:
+
+1. **Parity** — the table-sliced Pallas kernel (interpret mode on this
+   CPU mesh — the same program a TPU compiles) matches the one-hot
+   ``kv_cache.paged_attend`` baseline: fp32 logits at tight tolerance,
+   bf16 pools at ulp-bounded tolerance (the baseline combines values in
+   bf16, the kernel accumulates fp32 — the kernel is the MORE accurate
+   side), across ragged contexts, partial final blocks, dead streams,
+   CoW-shared block ids, and the K=k+1 verify-row variant.
+2. **Bit-identity** — greedy token streams (plain and speculative) are
+   identical with the kernel on and off; the PR-12 shared-prefix
+   acceptance stream runs kernel-on under ``fail_on_recompile`` with
+   zero post-warmup retraces.
+3. **Gating** — ``paged_kernel_enabled`` honours True/False force, the
+   ``DS_PAGED_KERNEL`` env override, and "auto" = TPU-on/CPU-off.
+4. **Cost model** — analytic attend FLOPs / HBM bytes scale with
+   ceil(context/bs)*bs on the kernel side and with pool CAPACITY on the
+   one-hot side, and the engine feeds both into the serving aggregator.
+"""
+import dataclasses
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference import InferenceEngine, shared_prefix_requests
+from deepspeed_tpu.inference import kv_cache
+from deepspeed_tpu.models.gpt2 import GPT2_CONFIGS, gpt2_init
+from deepspeed_tpu.ops import paged_attention as pa
+from deepspeed_tpu.ops.flash_attention import NEG_INF
+
+CFG32 = dataclasses.replace(GPT2_CONFIGS["gpt2-tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def params32():
+    return gpt2_init(jax.random.PRNGKey(0), CFG32)
+
+
+# --------------------------------------------------------------------- #
+# Direct kernel-vs-one-hot parity
+# --------------------------------------------------------------------- #
+def _ref_attend(q, pool_k, pool_v, bt, pos, scale):
+    """The one-hot baseline exactly as inference/decode.py builds it."""
+    J, bs = bt.shape[2], pool_k.shape[3]
+    sel = kv_cache.block_select(bt, pool_k.shape[1])
+    grid = jnp.arange(J * bs, dtype=jnp.int32)[None, None, None, :]
+    pos_mask = grid <= pos[..., None]
+    return kv_cache.paged_attend(q, pool_k, pool_v, sel, pos_mask,
+                                 scale, NEG_INF)
+
+
+def _case(seed, lengths, *, K=1, nH=4, D=16, B=12, bs=8, J=4,
+          kv_dtype=jnp.float32, shared_prefix_blocks=0):
+    """Build a [G, Q, ...] case from per-stream context lengths.
+
+    ``lengths[g][q]`` <= 0 marks a dead stream (DEAD_BLOCK table row).
+    ``shared_prefix_blocks`` aliases the first blocks of every live
+    stream in a group to the same ids — the post-CoW-fork layout where
+    read-only prefix blocks stay shared.
+    """
+    rng = np.random.default_rng(seed)
+    G, Q = len(lengths), len(lengths[0])
+    pool_k = rng.standard_normal((G, B, nH, bs, D)).astype(np.float32)
+    pool_v = rng.standard_normal((G, B, nH, bs, D)).astype(np.float32)
+    q = rng.standard_normal((G, Q, K, nH, D)).astype(np.float32)
+    bt = np.full((G, Q, J), kv_cache.DEAD_BLOCK, np.int32)
+    pos = np.zeros((G, Q, K), np.int32)
+    for g in range(G):
+        free = list(range(B))
+        shared = [free.pop() for _ in range(shared_prefix_blocks)]
+        for s in range(Q):
+            ctx = lengths[g][s]
+            if ctx <= 0:
+                continue                    # dead stream
+            # K query rows sit at positions ctx-1 .. ctx-1+K-1 (the
+            # verify step's per-row causal offsets).
+            nblk = (ctx - 1 + K - 1) // bs + 1
+            assert nblk <= J, "case exceeds table width"
+            ids = (shared[:nblk] + [free.pop() for _ in
+                                    range(max(0, nblk - len(shared)))])
+            bt[g, s, :nblk] = ids[:nblk]
+            pos[g, s] = ctx - 1 + np.arange(K)
+    to_dev = lambda a: jnp.asarray(a, kv_dtype)  # noqa: E731
+    return (jnp.asarray(q), to_dev(pool_k), to_dev(pool_v),
+            jnp.asarray(bt), jnp.asarray(pos), 1.0 / math.sqrt(D))
+
+
+class TestKernelParity:
+    def test_fp32_ragged_contexts_and_partial_blocks(self):
+        # Lengths straddle block boundaries: full final block (16),
+        # one-row final block (17), mid-block (13), single token (1),
+        # and a dead stream — the shapes the serving batch actually has.
+        q, pk, pv, bt, pos, sc = _case(0, [[16, 17, 13, 1], [25, 0, 8, 5]])
+        out = pa.paged_attention(q, pk, pv, bt, pos, scale=sc)
+        ref = _ref_attend(q, pk, pv, bt, pos, sc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_verify_rows_per_row_causal_offsets(self):
+        # K=4 (spec_k=3 verify): row k of a stream attends through
+        # position ctx-1+k — the final row can spill into a block the
+        # earlier rows must not see.
+        q, pk, pv, bt, pos, sc = _case(1, [[7, 15, 21], [3, 12, 0]], K=4)
+        out = pa.paged_attention(q, pk, pv, bt, pos, scale=sc)
+        ref = _ref_attend(q, pk, pv, bt, pos, sc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_bf16_pool_dequant_ulp_bounded(self):
+        # bf16 pools: the kernel upcasts tiles in-VMEM and accumulates
+        # fp32; the baseline's value combine runs in bf16. They agree to
+        # bf16 resolution (the kernel side is the more accurate one).
+        q, pk, pv, bt, pos, sc = _case(2, [[9, 18, 24, 2]],
+                                       kv_dtype=jnp.bfloat16)
+        out = pa.paged_attention(q, pk, pv, bt, pos, scale=sc)
+        ref = _ref_attend(q, pk, pv, bt, pos, sc)
+        np.testing.assert_allclose(np.asarray(out, np.float32),
+                                   np.asarray(ref, np.float32),
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_cow_shared_prefix_blocks(self):
+        # Post-fork layout: every live stream's first two blocks are the
+        # SAME pool blocks (refcounted prefix), tails diverge.
+        q, pk, pv, bt, pos, sc = _case(
+            3, [[17, 20, 25]], shared_prefix_blocks=2)
+        assert (np.asarray(bt)[0, :, :2] ==
+                np.asarray(bt)[0, 0, :2]).all()
+        out = pa.paged_attention(q, pk, pv, bt, pos, scale=sc)
+        ref = _ref_attend(q, pk, pv, bt, pos, sc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_dead_streams_emit_exact_zeros(self):
+        q, pk, pv, bt, pos, sc = _case(4, [[11, 0, 0, 6]])
+        out = np.asarray(pa.paged_attention(q, pk, pv, bt, pos, scale=sc))
+        assert (out[0, 1] == 0.0).all() and (out[0, 2] == 0.0).all()
+        assert np.abs(out[0, 0]).sum() > 0
+
+    def test_head_block_tilings_agree(self):
+        # The autotuner's candidates are tilings of the SAME math: any
+        # bh dividing nH must reproduce bh=1 bit-for-bit (fp32 scratch
+        # accumulation order per head is unchanged by head grouping).
+        q, pk, pv, bt, pos, sc = _case(5, [[14, 22, 5, 0]])
+        outs = [np.asarray(pa.paged_attention(q, pk, pv, bt, pos,
+                                              scale=sc, block_heads=bh))
+                for bh in (1, 2, 4)]
+        np.testing.assert_array_equal(outs[0], outs[1])
+        np.testing.assert_array_equal(outs[0], outs[2])
+
+
+# --------------------------------------------------------------------- #
+# Gating contract
+# --------------------------------------------------------------------- #
+class TestGating:
+    def test_forced_flags_win(self, monkeypatch):
+        monkeypatch.setenv("DS_PAGED_KERNEL", "1")
+        assert pa.paged_kernel_enabled(False) is False
+        monkeypatch.setenv("DS_PAGED_KERNEL", "0")
+        assert pa.paged_kernel_enabled(True) is True
+
+    def test_env_overrides_auto(self, monkeypatch):
+        monkeypatch.setenv("DS_PAGED_KERNEL", "1")
+        assert pa.paged_kernel_enabled("auto") is True
+        monkeypatch.setenv("DS_PAGED_KERNEL", "0")
+        assert pa.paged_kernel_enabled("auto") is False
+
+    def test_auto_is_backend_gated(self, monkeypatch):
+        monkeypatch.delenv("DS_PAGED_KERNEL", raising=False)
+        expected = jax.default_backend() == "tpu"   # False on this mesh
+        assert pa.paged_kernel_enabled("auto") is expected
+
+    def test_config_validation(self, params32):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfigError
+        with pytest.raises(DeepSpeedConfigError, match="paged_kernel"):
+            InferenceEngine(CFG32, params32, config={
+                "inference": {"max_slots": 2, "max_seq_len": 32,
+                              "block_size": 8, "paged_kernel": "yes"}})
+
+
+# --------------------------------------------------------------------- #
+# Analytic cost model
+# --------------------------------------------------------------------- #
+class TestAttendCostModel:
+    def test_kernel_bytes_scale_with_block_rounded_context(self):
+        bs, nH, D = 8, 4, 16
+        f = lambda ctx: pa.attend_hbm_bytes_per_token(   # noqa: E731
+            nH, D, bs, context=ctx)
+        # Within one block the cost is flat; crossing a boundary adds
+        # exactly one block's K+V bytes.
+        assert f(1) == f(8) == 2 * 8 * nH * D * 4
+        assert f(9) == f(16) == 2 * f(8)
+        assert f(17) - f(16) == 2 * bs * nH * D * 4
+        # ceil(ctx/bs)*bs rows exactly, never pool-sized.
+        assert f(25) == 2 * 32 * nH * D * 4
+
+    def test_onehot_bytes_are_pool_capacity_flat(self):
+        bs, nH, D, B = 8, 4, 16, 64
+        b = pa.attend_hbm_bytes_per_token(nH, D, bs, pool_blocks=B)
+        assert b == 2 * B * bs * nH * D * 4
+        # Independent of any context — it streams the whole pool.
+        assert b > pa.attend_hbm_bytes_per_token(nH, D, bs, context=B * bs
+                                                 - bs + 1) - 1
+
+    def test_flops_and_arg_validation(self):
+        assert pa.attend_flops_per_token(4, 16, 8, context=8) \
+            == 4 * 4 * 16 * 8
+        assert pa.attend_flops_per_token(4, 16, 8, pool_blocks=2,
+                                         num_layers=3) \
+            == 4 * 4 * 16 * 16 * 3
+        with pytest.raises(ValueError, match="exactly one"):
+            pa.attend_flops_per_token(4, 16, 8)
+        with pytest.raises(ValueError, match="exactly one"):
+            pa.attend_hbm_bytes_per_token(4, 16, 8, context=4,
+                                          pool_blocks=2)
+
+
+# --------------------------------------------------------------------- #
+# Engine-level: kernel on vs off on the dp=8 mesh
+# --------------------------------------------------------------------- #
+def _engine(params, *, kernel, slots=8, max_len=64, chunk=8,
+            block_size=8, spec_k=0, **tel):
+    config = {"inference": {"max_slots": slots, "max_seq_len": max_len,
+                            "prefill_chunk": chunk,
+                            "block_size": block_size,
+                            "spec_k": spec_k, "paged_kernel": kernel}}
+    config.update(tel)
+    return InferenceEngine(CFG32, params, config=config)
+
+
+def _prompt(n, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, CFG32.vocab_size, size=n).astype(np.int32)
+
+
+def paged_attn_bytes(sp_):
+    """The engine's own live-ctx_max quote, recomputed independently."""
+    return pa.attend_hbm_bytes_per_token(
+        sp_.num_heads, sp_.head_dim, sp_.block_size, context=sp_.max_len,
+        kv_itemsize=jnp.dtype(sp_.dtype).itemsize,
+        num_layers=sp_.num_layers)
+
+
+class TestEngineKernelOn:
+    def test_decode_logit_parity_and_greedy_bit_identity(self, params32):
+        streams, logits = {}, {}
+        for kernel in (False, True):
+            e = _engine(params32, kernel=kernel)
+            assert e.paged_kernel is kernel
+            toks, logs = [], []
+            for s, n in ((0, 11), (1, 17)):   # partial + cross-block ctx
+                tok, lg = e.prefill(_prompt(n, seed=s), slot=s,
+                                    return_logits=True)
+                e.activate_slot(s, n, tok)
+                toks.append([tok])
+                logs.append([np.asarray(lg)])
+            for _ in range(6):
+                tok, lg = e.decode_once(return_logits=True)
+                for i, s in enumerate((0, 1)):
+                    toks[i].append(int(np.asarray(tok)[s]))
+                    logs[i].append(np.asarray(lg)[s])
+            e.close()
+            streams[kernel] = toks
+            logits[kernel] = logs
+        assert streams[True] == streams[False]      # greedy bit-identity
+        for a, b in zip(logits[True], logits[False]):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-5, rtol=1e-5)
+
+    def test_spec_decode_streams_bit_identical(self, params32):
+        emitted = {}
+        for kernel in (False, True):
+            e = _engine(params32, kernel=kernel, spec_k=3)
+            n = 13
+            tok, _ = e.prefill(_prompt(n, seed=7), slot=0,
+                               return_logits=True)
+            e.activate_slot(0, n, tok)
+            out = [tok]
+            for _ in range(4):
+                toks, n_new = e.spec_decode_once()
+                k = int(np.asarray(n_new)[0])
+                out.extend(int(t) for t in np.asarray(toks)[0][:k])
+            e.close()
+            emitted[kernel] = out
+        assert emitted[True] == emitted[False]
+
+    def test_acceptance_stream_kernel_on_zero_recompiles(
+            self, params32, tmp_path):
+        # The PR-12 acceptance workload, kernel forced ON, retrace =
+        # hard failure: proves the static-shape discipline (grid sized
+        # by table WIDTH, predication for liveness) holds across chunked
+        # prefill, CoW forks, spec verify, and ragged completion.
+        e = _engine(params32, kernel=True, spec_k=3,
+                    telemetry={"enabled": True,
+                               "output_path": str(tmp_path),
+                               "job_name": "pk_accept",
+                               "report_steps": 10 ** 9,
+                               "fail_on_recompile": True})
+        report = e.serve(shared_prefix_requests(
+            6, prefix_len=16, tail_len=(3, 8), max_new_tokens=4,
+            vocab_size=CFG32.vocab_size))
+        assert report["recompiles"] == 0
+        assert report["completed"] == 6
+        # The serving aggregator priced the attend both ways: the
+        # structural ratio exists and the kernel side is strictly less
+        # work than streaming the pool.
+        assert report["attend"]["mode"] == "kernel"
+        assert report["attend_work_ratio"] > 1.0
+        e.close()
+
+    def test_attend_telemetry_meta_labeled_projection(self, params32):
+        e = _engine(params32, kernel=True)
+        meta = e.telemetry.meta
+        assert meta["paged_kernel"] is True
+        for key in ("attend_flops_per_token", "attend_hbm_bytes_per_token"):
+            assert meta[key]["projection"] == "analytic"
+            assert meta[key]["pool_capacity"] >= meta[key]["live_ctx_max"]
+        # live-ctx bound is the block-rounded max context, never pool-
+        # sized: blocks_per_group * bs >= ceil(max_len/bs) * bs here.
+        sp_ = e.cache_spec
+        assert meta["attend_hbm_bytes_per_token"]["live_ctx_max"] == \
+            paged_attn_bytes(sp_)
+        e.close()

@@ -40,7 +40,11 @@ regression = a relative RISE beyond ``--hbm-rise``, default 15%) and
 the spec-decode ``serving.spec.acceptance_rate`` (new side must clear
 ``--accept-floor``, default 0.05, and must not drop more than
 ``--serve-drop`` relative vs the old side when both carry it) —
-pre-paging/pre-spec rounds skip these, never fail. A TELEMETRY.json carrying a ``health``
+pre-paging/pre-spec rounds skip these, never fail. Paged-attention
+rounds gate ``serving.attend_work_ratio`` (the analytic one-hot-over-
+kernel attend HBM ratio the engine prices per iteration; regression =
+a relative DROP beyond ``--attend-drop``, default 10% — the structural
+win shrank); pre-kernel rounds skip, never fail. A TELEMETRY.json carrying a ``health``
 section is additionally validated on the NEW side alone: UNSKIPPED
 non-finite anomalies (overflow-skipped steps are routine fp16
 loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
@@ -127,6 +131,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     # serving-mode TELEMETRY.json's "serving" section (same keys).
     hbm_per_token: Optional[float] = None
     accept_rate: Optional[float] = None
+    attend_ratio: Optional[float] = None
     srv = doc.get("serving")
     if isinstance(srv, dict) and (srv.get("available", True)):
         v = srv.get("tokens_per_s")
@@ -145,6 +150,13 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         if isinstance(spec, dict) and \
                 spec.get("acceptance_rate") is not None:
             accept_rate = float(spec["acceptance_rate"])
+        # Paged-attention rounds: the analytic kernel-vs-one-hot
+        # attend-work ratio (one-hot pool-capacity HBM bytes over the
+        # kernel's live-context bytes, same iterations — regression =
+        # DROP: the structural win shrank). Pre-kernel rounds carry no
+        # field -> skipped, never failed.
+        if srv.get("attend_work_ratio") is not None:
+            attend_ratio = float(srv["attend_work_ratio"])
     # MoE shape: a TELEMETRY.json `moe` section or an MOE_BENCH.json
     # record — the gated figure is the drop-fraction p95 (regression =
     # an ABSOLUTE rise: dropped tokens are silently-skipped compute).
@@ -213,6 +225,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "tile_speedup": tile_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
+            "attend_ratio": attend_ratio,
             "moe_drop": moe_drop, "dcn_bytes": dcn_bytes,
             "ckpt_share": ckpt_share, "ckpt_every": ckpt_every}
 
@@ -240,7 +253,8 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
          ttft_rise: float = 0.25, kernel_drop: float = 0.10,
          hbm_rise: float = 0.15, accept_floor: float = 0.05,
          moe_drop_rise: float = 0.05, dcn_rise: float = 0.10,
-         ckpt_share_max: float = 0.05, tile_drop: float = 0.10) -> int:
+         ckpt_share_max: float = 0.05, tile_drop: float = 0.10,
+         attend_drop: float = 0.10) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -342,6 +356,24 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         missing = [n for n, m in ((name_old, old), (name_new, new))
                    if m["tile_speedup"] is None]
         print(f"autotune tile speedup: skipped (no tile record in "
+              f"{', '.join(missing)})")
+
+    if old["attend_ratio"] is not None and \
+            new["attend_ratio"] is not None:
+        compared += 1
+        floor = old["attend_ratio"] * (1.0 - attend_drop)
+        verdict = "OK" if new["attend_ratio"] >= floor else "REGRESSION"
+        print(f"serving attend work ratio: {name_old}="
+              f"{old['attend_ratio']:.4g}x -> "
+              f"{name_new}={new['attend_ratio']:.4g}x "
+              f"(floor {floor:.4g}x, -{attend_drop:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-paged-kernel rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["attend_ratio"] is None]
+        print(f"serving attend work ratio: skipped (no attend record in "
               f"{', '.join(missing)})")
 
     if old["hbm_per_token"] is not None and \
@@ -512,6 +544,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tile-drop", type=float, default=0.10,
                     help="max tolerated RELATIVE drop of the autotuned-"
                          "tile speedup vs heuristics (default 0.10)")
+    ap.add_argument("--attend-drop", type=float, default=0.10,
+                    help="max tolerated RELATIVE drop of the serving "
+                         "kernel-vs-one-hot attend-work ratio "
+                         "(default 0.10)")
     ap.add_argument("--hbm-rise", type=float, default=0.15,
                     help="max tolerated RELATIVE rise of serving HBM "
                          "bytes per cached token (default 0.15)")
@@ -545,7 +581,8 @@ def main(argv=None) -> int:
                     args.serve_drop, args.ttft_rise, args.kernel_drop,
                     args.hbm_rise, args.accept_floor, args.moe_drop_rise,
                     args.dcn_rise, args.ckpt_share_max,
-                    tile_drop=args.tile_drop)
+                    tile_drop=args.tile_drop,
+                    attend_drop=args.attend_drop)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

@@ -283,16 +283,19 @@ def build_serving():
         cfg, gpt2_init(jax.random.PRNGKey(0), cfg),
         config={"inference": {"max_slots": 8, "max_seq_len": 64,
                               "prefill_chunk": 8, "block_size": 8,
-                              "spec_k": 3},
+                              "spec_k": 3, "paged_kernel": True},
                 "telemetry": _tel("serving")})
     # Register every paged compiled path with the sentinel: an exact
     # re-admission forks copy-on-write (copy_block), the shared-prefix
     # serve runs batched chunk prefills + speculative verify steps, and
-    # one plain decode covers the non-spec decode path. The serving
-    # contract the passes then gate: materialization must prove no
-    # full-pool gather through the block-table one-hot contractions,
-    # and host_sync must show zero in-step transfers (the one
-    # token-fetch per iteration happens outside the programs).
+    # one plain decode covers the non-spec decode path. paged_kernel is
+    # forced ON (interpret mode on this CPU mesh) so the audited
+    # programs are the Pallas table-sliced attend the TPU runs — a
+    # kernel-on engine declares zero one-hot score budget, so a clean
+    # materialization pass here IS the proof that kernel decode/verify/
+    # prefill never build a pool-sized intermediate. host_sync must
+    # still show zero in-step transfers (the one token-fetch per
+    # iteration happens outside the programs).
     rng = np.random.default_rng(0)
     p32 = rng.integers(0, cfg.vocab_size, size=32).astype(np.int32)
     for _ in range(2):                      # second pass hits CoW

@@ -24,13 +24,21 @@ transfers is the structure — occupancy, zero recompiles, the
 prefill/decode split, HBM-per-token, acceptance and hit rates.
 ``tools/bench_gate.py`` diffs serving rounds on these figures.
 
+PR-17 adds a paged-attention kernel A/B (``kernel_ablation`` in the
+artifact): the same reduced stream served with the Pallas kernel forced
+on (interpret mode on CPU) and with the one-hot contraction, greedy
+token streams asserted bit-identical, plus the analytic attend-work
+ratio and a projection-labeled decode-ms estimate. Skip with
+``--no-ablation``.
+
 Usage:
     python tools/serve_bench.py [--model gpt2-tiny] [--slots 8]
         [--requests 24] [--max-new 16] [--chunk 8] [--max-len 128]
         [--block-size 16] [--num-blocks 0] [--spec-k 4] [--replicas 2]
         [--workload shared-prefix|random] [--prefix-len 32]
         [--rate 0.0] [--quantize none] [--temperature 0.0]
-        [--no-baseline] [--out SERVE_BENCH.json]
+        [--no-baseline] [--no-ablation] [--ablation-requests 6]
+        [--out SERVE_BENCH.json]
 """
 import argparse
 import json
@@ -70,11 +78,17 @@ def _requests(args, vocab_size):
         vocab_size=vocab_size, seed=args.seed)
 
 
-def _serve(args, cfg, params, *, replicas, block_size, spec_k, label):
+def _serve(args, cfg, params, *, replicas, block_size, spec_k, label,
+           paged_kernel=None):
     """Build `replicas` engines and run the stream; returns (report,
-    telemetry dir of replica 0)."""
+    telemetry dir of replica 0). ``paged_kernel`` None leaves the
+    engine's "auto" gate in charge (off on this CPU mesh); True/False
+    force the Pallas path (interpret mode on CPU) / one-hot baseline."""
     from deepspeed_tpu.inference import InferenceEngine, ReplicaRouter
 
+    inf_cfg_extra = {}
+    if paged_kernel is not None:
+        inf_cfg_extra["paged_kernel"] = paged_kernel
     tel_dir = tempfile.mkdtemp(prefix=f"serve_bench_{label}_")
     engines = []
     for i in range(replicas):
@@ -86,7 +100,8 @@ def _serve(args, cfg, params, *, replicas, block_size, spec_k, label):
                           "num_blocks": args.num_blocks,
                           "spec_k": spec_k,
                           "quantize": args.quantize,
-                          "replica": f"r{i}"},
+                          "replica": f"r{i}",
+                          **inf_cfg_extra},
             "telemetry": {"enabled": True, "output_path": tel_dir,
                           "job_name": f"serve_bench_r{i}",
                           "report_steps": 16,
@@ -110,6 +125,60 @@ def _serve(args, cfg, params, *, replicas, block_size, spec_k, label):
     for e in engines:
         e.close()
     return report, tel_dir
+
+
+def _kernel_ablation(args, cfg, params):
+    """Paged-attention kernel on/off A/B: the SAME request stream served
+    twice on one replica — Pallas kernel forced on (interpret mode on
+    this CPU mesh) vs the one-hot contraction baseline — with greedy
+    token streams asserted identical before any number is recorded.
+    Interpret-mode wall time measures the Pallas interpreter, not a
+    TPU, so the recorded decode-ms projection scales the MEASURED
+    one-hot decode step by the analytic attend HBM-bytes ratio and is
+    labeled as such."""
+    ab = argparse.Namespace(**vars(args))
+    ab.requests = min(args.requests, args.ablation_requests)
+    ab.replicas = 1
+    sides = {}
+    for name, flag in (("onehot", False), ("kernel", True)):
+        print(f"[serve_bench] kernel ablation: {ab.requests} requests, "
+              f"paged_kernel={flag} ...", flush=True)
+        report, _ = _serve(ab, cfg, params, replicas=1,
+                           block_size=args.block_size,
+                           spec_k=args.spec_k, label=f"ab_{name}",
+                           paged_kernel=flag)
+        sides[name] = report
+    toks = {name: {r["rid"]: r["tokens"] for r in rep["requests"]}
+            for name, rep in sides.items()}
+    parity = toks["kernel"] == toks["onehot"]
+    if args.temperature == 0.0 and not parity:
+        raise SystemExit(
+            "[serve_bench] kernel ablation FAILED: greedy token streams "
+            "diverge between the Pallas kernel and the one-hot baseline")
+    off, on = sides["onehot"], sides["kernel"]
+    ratio = off.get("attend_work_ratio")
+    off_p50 = off["decode_step_ms"]["p50"]
+    rec = {
+        "requests": ab.requests,
+        "tokens_compared": sum(len(t) for t in toks["onehot"].values()),
+        "greedy_parity": bool(parity),
+        "attend_work_ratio": ratio,
+        "attend": off.get("attend"),
+        "recompiles": {"onehot": off["recompiles"],
+                       "kernel": on["recompiles"]},
+        "decode_step_ms_p50": {
+            "onehot": off_p50,
+            "kernel_interpret": on["decode_step_ms"]["p50"]},
+        "projected_decode_step_ms_p50": round(off_p50 / ratio, 3)
+        if ratio else None,
+        "projection_note": (
+            "projected figure = measured one-hot decode p50 divided by "
+            "the analytic attend HBM-bytes ratio; assumes attend-HBM-"
+            "bound decode on a real TPU. kernel_interpret wall time "
+            "measures the Pallas interpreter on CPU — never compare it "
+            "to the one-hot number."),
+    }
+    return rec
 
 
 def main():
@@ -144,6 +213,11 @@ def main():
                          "the measured stream (0 = cold, PR-7 style)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the slot-major single-replica baseline")
+    ap.add_argument("--no-ablation", action="store_true",
+                    help="skip the paged-attention kernel on/off A/B")
+    ap.add_argument("--ablation-requests", type=int, default=6,
+                    help="request cap for the kernel A/B (interpret "
+                         "mode is slow on CPU)")
     ap.add_argument("--out", default=os.path.join(REPO, "SERVE_BENCH.json"))
     args = ap.parse_args()
 
@@ -167,6 +241,10 @@ def main():
               "same stream ...", flush=True)
         baseline, _ = _serve(args, cfg, params, replicas=1, block_size=0,
                              spec_k=0, label="slotmajor")
+
+    ablation = None
+    if not args.no_ablation and args.block_size:
+        ablation = _kernel_ablation(args, cfg, params)
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from telemetry_report import summarize
@@ -208,6 +286,8 @@ def main():
             "paging, prefix hit rate, and the spec-decode acceptance "
             "rate."),
     }
+    if ablation is not None:
+        record["kernel_ablation"] = ablation
     if baseline is not None:
         record["baseline_slot_major"] = {
             k: v for k, v in baseline.items()
@@ -243,6 +323,14 @@ def main():
     if record.get("vs_slot_major"):
         print(f"[serve_bench] vs slot-major baseline: "
               f"{record['vs_slot_major']}")
+    if ablation is not None:
+        print(f"[serve_bench] kernel ablation: parity="
+              f"{ablation['greedy_parity']} over "
+              f"{ablation['tokens_compared']} tokens, attend work x"
+              f"{ablation['attend_work_ratio']}, projected decode p50="
+              f"{ablation['projected_decode_step_ms_p50']} ms "
+              f"(measured one-hot "
+              f"{ablation['decode_step_ms_p50']['onehot']} ms)")
     if s["recompiles"] or s["unfinished"]:
         print("[serve_bench] FAILED acceptance (recompiles or unfinished "
               "requests)")

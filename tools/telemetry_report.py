@@ -463,9 +463,12 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "prefill_tokens": serve_snap.get("prefill_tokens"),
             "decode_tokens": serve_snap.get("decode_tokens"),
         })
-        # Paged-cache / spec-decode sections of the aggregator snapshot
-        # pass through when present (pre-paging streams carry none).
-        for sec in ("hbm_bytes_per_token", "prefix", "spec", "replica"):
+        # Paged-cache / spec-decode / attend-work sections of the
+        # aggregator snapshot pass through when present (pre-paging
+        # streams carry none; ``attend`` is the analytic kernel-vs-
+        # one-hot pricing, projection-labeled at the source).
+        for sec in ("hbm_bytes_per_token", "prefix", "spec", "replica",
+                    "attend", "attend_work_ratio"):
             if serve_snap.get(sec) is not None:
                 serving[sec] = serve_snap[sec]
         # Multi-replica streams: request_complete events carry replica
@@ -667,6 +670,9 @@ def main(argv=None) -> int:
           + (f", serving: occ={srv['occupancy_mean']}, "
              f"ttft p50={srv['ttft_ms']['p50']}ms"
              if srv.get("available") else "")
+          + (f", attend x{srv['attend_work_ratio']} "
+             f"({srv['attend']['mode']}, projected)"
+             if srv.get("attend_work_ratio") is not None else "")
           + health_bits
           + (" — TRUNCATED segment (no final drain marker): stats "
              "cover a partial run" if summary["truncated"] else ""))
