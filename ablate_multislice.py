@@ -99,10 +99,70 @@ def project(model_name: str, slices: int, dp: int, chip: str = "v5e"):
     }
 
 
+def project_zero3(model_name: str, slices: int, dp: int,
+                  chip: str = "v5e"):
+    """ISSUE 18 headline figure: stage-3 across slices, flat vs
+    hierarchical analytic walls. Under the flat lowering the param
+    all-gathers bind the JOINT (slice, data) group — 2 gathers/step of
+    compute-dtype param bytes ride every link including the DCN
+    boundary. The axis-algebra planner binds them to `data` instead:
+    all gather traffic stays on ICI and the DCN hop is the same 1/dp
+    f32 residual stage 2 ships — zero param bytes on the slow tier."""
+    cfg = GPT2_CONFIGS[model_name]
+    shapes = jax.eval_shape(
+        lambda k: gpt2_init(k, cfg), jax.random.PRNGKey(0))
+    pbytes = jnp.dtype(cfg.dtype).itemsize
+    model = hlo_audit.grad_sync_wire_model(
+        shapes, dp, slices=slices, zero3=True,
+        param_bytes_per_el=pbytes)
+    peaks = peaks_for_kind(chip)
+
+    def ms(nbytes: float, bw_bytes_per_s: float) -> float:
+        return nbytes / bw_bytes_per_s * 1e3
+
+    rows = {
+        "flat": {
+            "ici_bytes_per_step": model["ici_wire_bytes"],
+            "dcn_bytes_per_step": int(model["flat_dcn_link_bytes"]),
+            "dcn_param_bytes": 2 * model["param_gather_payload_bytes"],
+            "note": "joint (slice, data) gathers + scatter: both "
+                    "compute-dtype param gathers cross the DCN "
+                    "boundary links every micro-step",
+        },
+        "hierarchical": {
+            "ici_bytes_per_step": model["ici_wire_bytes"],
+            "dcn_bytes_per_step": model["dcn_wire_bytes"],
+            "dcn_param_bytes": model["dcn_param_bytes"],
+            "note": "planner-derived: gathers bind `data` (ICI only); "
+                    "DCN carries the 1/dp f32 residual once per step",
+        },
+    }
+    for row in rows.values():
+        t_ici = ms(row["ici_bytes_per_step"], peaks.ici_bytes_per_sec)
+        t_dcn = ms(row["dcn_bytes_per_step"], peaks.dcn_bytes_per_sec)
+        row.update(projected_t_ici_ms=round(t_ici, 4),
+                   projected_t_dcn_ms=round(t_dcn, 4),
+                   projected_comm_floor_ms=round(max(t_ici, t_dcn), 4),
+                   comm_bound_tier="dcn" if t_dcn > t_ici else "ici")
+    return {
+        "model": model_name,
+        "slices": slices,
+        "dp_per_slice": dp,
+        "param_bytes_per_el": int(pbytes),
+        "chip": peaks.as_dict(),
+        "wire_model": {k: v for k, v in model.items() if k != "moe"},
+        "schedules": rows,
+    }
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--record", action="store_true",
                     help="write MULTISLICE_BENCH.json")
+    ap.add_argument("--zero3", action="store_true",
+                    help="add the stage-3-across-slices section (flat "
+                         "joint-axis gathers vs planner-derived "
+                         "ICI-only gathers)")
     ap.add_argument("--slices", type=int, default=2)
     ap.add_argument("--dp", type=int, default=64,
                     help="dp degree WITHIN one slice (default 64 — one "
@@ -145,6 +205,24 @@ def main() -> int:
                 max(1, hc["dcn_bytes_per_step"]), 2),
         },
     }
+    if args.zero3:
+        z3 = project_zero3(args.model, args.slices, args.dp)
+        zf = z3["schedules"]["flat"]
+        zh = z3["schedules"]["hierarchical"]
+        rec["projection_zero3"] = z3
+        # The gated stage-3 figures: the planner's schedule must keep
+        # ZERO param bytes on DCN; the flat joint-axis link bytes are
+        # the wall it avoids.
+        rec["zero3"] = {
+            "available": True,
+            "dcn_bytes_per_step": zh["dcn_bytes_per_step"],
+            "dcn_param_bytes_per_step": zh["dcn_param_bytes"],
+            "flat_dcn_link_bytes_per_step": zf["dcn_bytes_per_step"],
+            "ici_wire_bytes_per_step": zh["ici_bytes_per_step"],
+            "dcn_reduction_vs_flat": round(
+                zf["dcn_bytes_per_step"] /
+                max(1, zh["dcn_bytes_per_step"]), 2),
+        }
     print(json.dumps({k: rec["multislice"][k] for k in
                       ("dcn_bytes_per_step",
                        "dcn_bytes_per_step_compressed",
@@ -156,6 +234,13 @@ def main() -> int:
               f"{row['dcn_bytes_per_step']:,} B | floor "
               f"{row['projected_comm_floor_ms']} ms "
               f"({row['comm_bound_tier']}-bound)")
+    if args.zero3:
+        for name, row in rec["projection_zero3"]["schedules"].items():
+            print(f"[zero3/{name}] ici {row['ici_bytes_per_step']:,} B "
+                  f"| dcn {row['dcn_bytes_per_step']:,} B (param "
+                  f"{row['dcn_param_bytes']:,} B) | floor "
+                  f"{row['projected_comm_floor_ms']} ms "
+                  f"({row['comm_bound_tier']}-bound)")
     if args.record:
         with open(args.out, "w") as fobj:
             json.dump(rec, fobj, indent=1)

@@ -401,7 +401,16 @@ def collective_placement_pass(ctx: LintContext) -> List[LintFinding]:
     slices = int(meta.get("slices", 1) or 1)
     ep = int(meta.get("ep", 1) or 1)
     dp = int(meta.get("dp", 1) or 1)
-    outer = slices if slices > 1 else ep
+    # The expected schedule is DERIVED from the mesh factorization (the
+    # axis-algebra planner — the same derivation the builders execute
+    # and the wire model prices), not re-cased per axis pair here.
+    from ..parallel.axis_algebra import MeshFactorization
+    fact = MeshFactorization.from_sizes(slice=slices, expert=ep, data=dp)
+    try:
+        outer_axis = fact.outer_axis
+    except ValueError:
+        outer_axis = None   # unsupported factorization: no legal hop
+    outer = fact.size(outer_axis) if outer_axis else 1
     dcn_shard = {int(b) for b in (meta.get("dcn_shard_bytes") or ())}
     if outer > 1 and str(meta.get("grad_sync_mode")) == "explicit":
         grad_ars = [o for o in grad_ars
@@ -429,6 +438,33 @@ def collective_placement_pass(ctx: LintContext) -> List[LintFinding]:
                              "pushes grad-sized traffic over DCN; the "
                              "hierarchy moves only the 1/dp residual "
                              "there"),
+                    bytes=o.payload_bytes, wire_bytes=o.wire_bytes,
+                    priced=True, in_loop=o.in_loop,
+                    details={"op_name": o.op_name,
+                             "group_size": o.group_size,
+                             "dp": dp, "slices": slices}))
+    # Stage 3 across slices: the planner binds BOTH param gathers to
+    # `data` — an ICI axis on every factorization — so a param-sized
+    # gather whose replica groups are wider than dp spans the slice
+    # axis and ships param bytes over DCN (the joint-axis schedule the
+    # hierarchy exists to avoid). Engine meta carries the legal
+    # gathered-leaf payload sizes (zero3_gather_leaf_bytes).
+    z3_gather = {int(b)
+                 for b in (meta.get("zero3_gather_leaf_bytes") or ())}
+    if slices > 1 and z3_gather:
+        for o in ctx.audit.of_kind("all-gather"):
+            if o.payload_bytes not in z3_gather:
+                continue
+            if o.group_size > dp:
+                out.append(LintFinding(
+                    lint="collective_placement", path=ctx.name,
+                    key=f"param-spans-dcn:{','.join(o.out_shapes)}",
+                    summary=(f"param-sized all-gather of {o.out_shapes} "
+                             f"in groups of {o.group_size} (> dp={dp}) "
+                             "spans the slice axis — stage-3 gathers "
+                             "bind `data` (ICI only); a joint-axis "
+                             "gather ships param bytes over DCN every "
+                             "micro-step"),
                     bytes=o.payload_bytes, wire_bytes=o.wire_bytes,
                     priced=True, in_loop=o.in_loop,
                     details={"op_name": o.op_name,

@@ -1,0 +1,272 @@
+"""Axis algebra: one collective planner for every factored-mesh builder.
+
+The mesh axes exist (`slice`, `pipe`, `expert`, `data`, `seq`, `model` —
+parallel/topology.build_mesh) but until this module the COMPOSITIONS
+were hand-cased pairs: the explicit grad builder resolved its outer
+axis with an if/elif ladder, `multislice.classify_two_tier` re-derived
+the same group-signature arithmetic, the wire model asserted stage-3
+out of multi-slice meshes, and the collective_placement lint pass
+pattern-matched per pair. Each new axis pair meant touching all four.
+
+This module is the single derivation. From the mesh factorization alone
+(per-axis sizes) plus the ZeRO stage, it answers:
+
+- **scope**: which axes the explicit grad builder's ``shard_map`` binds
+  (``grad_shard_scope`` — the replica axes the batch shards over);
+- **schedule**: which axis each collective binds and where it sits
+  (``plan_grad_sync`` — param gathers and grad scatters on the
+  innermost replica axis, in-scan, once per micro-step; the accumulated
+  1/dp residual on the single OUTER replica axis, once per step);
+- **tier**: which wire each axis rides (``tier`` — the `slice` axis is
+  the only DCN axis; everything else is in-slice ICI), which is what
+  makes the headline composition fall out of the algebra instead of a
+  new special case: under ZeRO-3 the param all-gathers bind `data`, and
+  `data` is an ICI axis on EVERY factorization — so stage-3 across
+  slices gathers over ICI only and never puts a param-sized byte on
+  DCN;
+- **classification**: which tier a compiled collective's replica group
+  signature implies (``classify_group`` — the heuristic that used to
+  live in multislice.py, now stated once for audits AND lint).
+
+The planner is deliberately mesh-level: the per-LEAF rule (which dim a
+given leaf shards/scatters on) stays in ``runtime/zero/partition``
+(`_leaf_spec` / `spec_dp_dim` / `stage3_param_specs`) — the algebra
+here composes axes, not shapes.
+
+Unsupported compositions raise here, with the structural reason, so the
+engine's refusals quote the planner instead of maintaining their own
+blocker folklore (`MeshFactorization.outer_axis` on a slice×expert
+mesh: the residual hop supports exactly one outer axis today).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+from .topology import (DP_AXIS, EP_AXIS, MP_AXIS, PP_AXIS, SLICE_AXIS,
+                       SP_AXIS)
+
+__all__ = ["REPLICA_AXES", "DCN_AXES", "MeshFactorization",
+           "CollectiveStep", "GradSyncPlan", "plan_grad_sync"]
+
+# Grad-replica axes, outermost -> innermost: a gradient is summed over
+# exactly these. `data` is the innermost (the ZeRO shard axis); at most
+# one OUTER replica axis may be live per build (the residual hop).
+REPLICA_AXES: Tuple[str, ...] = (SLICE_AXIS, EP_AXIS, DP_AXIS)
+
+# Axes whose hops leave the ICI domain. Everything not listed is
+# in-slice by construction (build_mesh keeps `slice` outermost, so one
+# slice's devices stay contiguous on the fast tier).
+DCN_AXES: Tuple[str, ...] = (SLICE_AXIS,)
+
+_CANONICAL = (SLICE_AXIS, PP_AXIS, EP_AXIS, DP_AXIS, SP_AXIS, MP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshFactorization:
+    """Per-axis sizes of a (possibly virtual) device mesh, as the
+    planner's sole input. Hashable and mesh-library-free so plans can
+    be derived from lint meta / audit records as well as live meshes."""
+
+    axis_sizes: Tuple[Tuple[str, int], ...]
+
+    @classmethod
+    def from_mesh(cls, mesh) -> "MeshFactorization":
+        return cls.from_sizes(**{a: int(s) for a, s in mesh.shape.items()})
+
+    @classmethod
+    def from_sizes(cls, **sizes: int) -> "MeshFactorization":
+        for a in sizes:
+            if a not in _CANONICAL:
+                raise ValueError(f"unknown mesh axis {a!r} (known: "
+                                 f"{_CANONICAL})")
+        return cls(tuple((a, int(sizes.get(a, 1))) for a in _CANONICAL))
+
+    # ---- lookups --------------------------------------------------- #
+    @property
+    def shape(self) -> Dict[str, int]:
+        return dict(self.axis_sizes)
+
+    def size(self, axis: str) -> int:
+        return self.shape.get(axis, 1)
+
+    @property
+    def slices(self) -> int:
+        return self.size(SLICE_AXIS)
+
+    @property
+    def ep(self) -> int:
+        return self.size(EP_AXIS)
+
+    @property
+    def dp(self) -> int:
+        return self.size(DP_AXIS)
+
+    @property
+    def replicas(self) -> int:
+        """Grad replica count — the mean-correction divisor."""
+        n = 1
+        for a in REPLICA_AXES:
+            n *= self.size(a)
+        return n
+
+    # ---- the algebra ----------------------------------------------- #
+    def tier(self, axis: str) -> str:
+        """Which wire a collective bound to ``axis`` rides."""
+        return "dcn" if axis in DCN_AXES else "ici"
+
+    @property
+    def live_replica_axes(self) -> Tuple[str, ...]:
+        """Replica axes of size > 1, outermost first. `data` is always
+        included: it is the shard axis even at dp == 1 (degenerate
+        collectives are free)."""
+        return tuple(a for a in REPLICA_AXES
+                     if self.size(a) > 1 or a == DP_AXIS)
+
+    @property
+    def outer_axis(self) -> Optional[str]:
+        """The single replica axis OUTSIDE `data` carrying the
+        once-per-step residual hop, or None on a plain dp mesh. Raises
+        when more than one outer replica axis is live — the hierarchical
+        schedule (accumulate 1/dp shards locally, one residual
+        all-reduce at step end) composes exactly one outer axis today;
+        slice×expert needs a chained residual schedule that does not
+        exist yet."""
+        outer = [a for a in REPLICA_AXES[:-1] if self.size(a) > 1]
+        if len(outer) > 1:
+            raise ValueError(
+                "unsupported mesh factorization: more than one outer "
+                f"replica axis is live ({' x '.join(outer)}); the "
+                "hierarchical grad sync carries its once-per-step "
+                "residual over exactly one axis outside 'data'")
+        return outer[0] if outer else None
+
+    @property
+    def batch_axes(self) -> Tuple[str, ...]:
+        """Axes the global batch shards over jointly — also the
+        explicit grad builder's shard_map scope (``grad_shard_scope``)."""
+        outer = self.outer_axis
+        return (outer, DP_AXIS) if outer else (DP_AXIS,)
+
+    @property
+    def grad_shard_scope(self) -> Tuple[str, ...]:
+        return self.batch_axes
+
+    def classify_group(self, group_size: int) -> str:
+        """Tier implied by a compiled collective's replica-group SIZE
+        (the HLO parser records sizes, not member ids): on a factored
+        replica mesh with the outer axis outermost, inner collectives
+        form ``outer`` groups of ``dp`` consecutive members and outer
+        collectives form ``dp`` groups of ``outer`` strided members —
+        so group == dp ⇒ the inner tier, group == outer ⇒ the outer
+        axis's tier, group == outer*dp ⇒ a FLAT joint-axis collective
+        (every byte crosses the slow tier: the violation). Ambiguous
+        when outer == dp; audits pick shapes where they differ."""
+        outer = self.outer_axis
+        osize = self.size(outer) if outer else 1
+        if osize > 1 and osize == self.dp:
+            raise ValueError(
+                "tier classification by group signature is ambiguous "
+                f"when the outer axis size equals dp (= {self.dp}); "
+                "audit on a mesh where they differ")
+        if osize > 1 and group_size == osize * self.dp:
+            return "flat"
+        if group_size == self.dp:
+            return "ici"
+        if osize > 1 and group_size == osize:
+            return self.tier(outer)
+        return "other"
+
+
+# --------------------------------------------------------------------- #
+# The derived collective schedule
+# --------------------------------------------------------------------- #
+@dataclasses.dataclass(frozen=True)
+class CollectiveStep:
+    """One collective in a derived schedule: what it is, which axis it
+    binds, which wire that axis rides, and where it sits."""
+    op: str               # all-gather | reduce-scatter | all-reduce
+    axis: str             # mesh axis name
+    tier: str             # ici | dcn
+    placement: str        # in-scan (per micro-step) | per-step
+    payload: str          # human label: what crosses the wire
+
+    def to_meta(self) -> Dict[str, str]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass(frozen=True)
+class GradSyncPlan:
+    """The explicit path's full per-step collective schedule for one
+    mesh factorization + ZeRO stage, as derived structure: builders
+    execute it, the wire model prices it, lint and the comm audit
+    check the compiled program against it."""
+    fact: MeshFactorization
+    steps: Tuple[CollectiveStep, ...]
+
+    def _only(self, op: str) -> Optional[CollectiveStep]:
+        hits = [s for s in self.steps if s.op == op]
+        return hits[0] if hits else None
+
+    @property
+    def gather(self) -> Optional[CollectiveStep]:
+        """The ZeRO-3 param all-gather (None below stage 3)."""
+        return self._only("all-gather")
+
+    @property
+    def scatter(self) -> CollectiveStep:
+        """The in-scan grad reduce-scatter."""
+        return self._only("reduce-scatter")
+
+    @property
+    def residual(self) -> Optional[CollectiveStep]:
+        """The once-per-step outer-axis residual hop (None on a plain
+        dp mesh)."""
+        return self._only("all-reduce")
+
+    def to_meta(self) -> List[Dict[str, str]]:
+        return [s.to_meta() for s in self.steps]
+
+    def describe(self) -> str:
+        return "; ".join(
+            f"{s.op}[{s.axis}/{s.tier}, {s.placement}: {s.payload}]"
+            for s in self.steps)
+
+
+def plan_grad_sync(fact: MeshFactorization, *, zero3: bool = False,
+                   dcn_compression: bool = False) -> GradSyncPlan:
+    """Derive the explicit grad-sync schedule from the factorization.
+
+    The derivation, not a case table:
+
+    - params/grads shard over the INNERMOST replica axis (`data`), so
+      the ZeRO-3 gathers and the grad reduce-scatter bind `data` —
+      whose tier is ICI on every factorization (DCN_AXES) — and sit
+      inside the gas scan (each micro-step re-gathers and scatters into
+      the sharded carry);
+    - the accumulated 1/dp residual sums over the single OUTER replica
+      axis once per step; its tier is whatever that axis rides (`slice`
+      ⇒ DCN, `expert` ⇒ in-slice ICI), and only the DCN hop may be
+      1-bit compressed.
+
+    Hence the headline composition for free: slices×ZeRO-3 plans param
+    gathers as in-scan ICI steps and a residual-sized DCN hop — never a
+    param-sized byte on the slow tier.
+    """
+    steps: List[CollectiveStep] = []
+    if zero3:
+        steps.append(CollectiveStep(
+            "all-gather", DP_AXIS, fact.tier(DP_AXIS), "in-scan",
+            "param shards -> compute dtype (fwd + bwd re-gather)"))
+    steps.append(CollectiveStep(
+        "reduce-scatter", DP_AXIS, fact.tier(DP_AXIS), "in-scan",
+        "f32 grads -> owning 1/dp shard"))
+    outer = fact.outer_axis
+    if outer is not None:
+        tier = fact.tier(outer)
+        wire = "accumulated 1/dp residual"
+        if dcn_compression and tier == "dcn":
+            wire += " (1-bit error-feedback wire)"
+        steps.append(CollectiveStep("all-reduce", outer, tier,
+                                    "per-step", wire))
+    return GradSyncPlan(fact, tuple(steps))

@@ -403,7 +403,10 @@ def grad_sync_wire_model(params: Any, dp: int,
 
     - ``ici_wire_bytes``: the in-slice sync (reduce-scatter of
       scatterable + all-reduce of the replicated tail, over ``dp``) —
-      identical to the single-slice reduce-scatter figure;
+      identical to the single-slice reduce-scatter figure. Under
+      ``zero3`` BOTH param gathers join this term (the axis-algebra
+      planner binds them to `data`, an ICI axis on every
+      factorization), per micro-step like the scatter;
     - ``dcn_payload_bytes``: the per-rank residual that crosses slices
       (the 1/dp shard + the replicated tail, f32);
     - ``dcn_wire_bytes``: its inter-slice ring all-reduce over
@@ -418,8 +421,11 @@ def grad_sync_wire_model(params: Any, dp: int,
       the DCN traffic by dp.
 
     The headline total ``hierarchical_wire_bytes`` = ici + dcn (the
-    active dcn figure per ``dcn_compression``). slices > 1 excludes
-    ``zero3`` (not composed).
+    active dcn figure per ``dcn_compression``). With ``zero3`` the
+    output also pins ``dcn_param_bytes: 0`` (zero param-sized bytes on
+    the slow tier — the composition's claim) and carries the derived
+    ``collective_plan`` (axis_algebra.plan_grad_sync) the audit and
+    lint check the compiled program against.
     """
     import jax
     from .topology import DP_AXIS
@@ -489,10 +495,15 @@ def grad_sync_wire_model(params: Any, dp: int,
                             + 2 * one_gather),
         })
     if slices > 1:
-        assert not zero3, "multislice wire model: zero3 not composed"
+        from .axis_algebra import MeshFactorization, plan_grad_sync
         from .multislice import dcn_comm_bytes
+        fact = MeshFactorization.from_sizes(slice=slices, data=dp)
+        plan = plan_grad_sync(fact, zero3=zero3,
+                              dcn_compression=dcn_compression)
         # Per-rank residual after the in-slice reduce: the 1/dp shard of
-        # every scatterable leaf + the replicated tail, f32.
+        # every scatterable leaf + the replicated tail, f32. Stage-3
+        # changes NOTHING here — its grads land on the same 1/dp shards
+        # (gather_cast's transpose IS the in-slice reduce-scatter).
         dcn_el = scatterable_el // dp + replicated_el
         dcn_payload = dcn_el * 4
         dcn_wire = ring_wire_bytes("all-reduce", dcn_payload, slices)
@@ -500,18 +511,32 @@ def grad_sync_wire_model(params: Any, dp: int,
                                        num_slices=slices)
         dcn_wire_c = ring_wire_bytes("all-reduce", dcn_payload_c, slices)
         active_dcn = dcn_wire_c if dcn_compression else dcn_wire
+        # The in-slice (per-micro-step) tier: the grad reduce-scatter,
+        # plus — under stage 3 — both param gathers, which the planner
+        # places on `data`/ICI (param bytes NEVER ride DCN; the flat
+        # comparator below shows what a joint-axis schedule would ship).
+        ici = out["reduce_scatter_wire_bytes"]
+        flat_link = scatterable + replicated
+        if zero3:
+            assert plan.gather is not None and plan.gather.tier == "ici"
+            gather_payload = out["param_gather_payload_bytes"]
+            ici += 2 * ring_wire_bytes("all-gather", gather_payload, dp)
+            flat_link += 2 * gather_payload
         out.update({
             "slices": slices,
             "dcn_compression": bool(dcn_compression),
-            "ici_wire_bytes": out["reduce_scatter_wire_bytes"],
+            "ici_wire_bytes": int(ici),
             "dcn_payload_bytes": int(dcn_payload),
             "dcn_wire_bytes": int(dcn_wire),
             "dcn_wire_bytes_compressed": int(dcn_wire_c),
             # A flat joint-(slice, data) ring pushes ~the full payload
-            # over EVERY link, DCN boundary links included.
-            "flat_dcn_link_bytes": int(scatterable + replicated),
-            "hierarchical_wire_bytes":
-                int(out["reduce_scatter_wire_bytes"] + active_dcn),
+            # over EVERY link, DCN boundary links included — under
+            # stage 3 that payload includes BOTH param gathers per
+            # micro-step, the figure the hierarchy zeroes out.
+            "flat_dcn_link_bytes": int(flat_link),
+            "dcn_param_bytes": 0,
+            "hierarchical_wire_bytes": int(ici + active_dcn),
+            "collective_plan": plan.to_meta(),
         })
     if moe is not None:
         m = moe_alltoall_wire_model(**moe)

@@ -142,34 +142,22 @@ def classify_two_tier(ops: List[Any], num_slices: int, dp: int,
     """Split audited collectives (``hlo_audit.CollectiveOp``) into the
     tier their replica groups ride.
 
-    Heuristic over the group signature (the parser records sizes, not
-    member ids): on a (slice, data) mesh with `slice` OUTERMOST, the
-    in-slice collectives form ``slices`` groups of ``dp`` consecutive
-    members, and the inter-slice collectives form ``dp`` groups of
-    ``slices`` dp-strided members — so group_size == dp ⇒ ICI,
-    group_size == num_slices ⇒ DCN, group_size == slices*dp ⇒ a FLAT
-    joint-axis collective (every byte crosses DCN — the violation).
-    Ambiguous when slices == dp; callers (tools/comm_audit.py, the
-    tier-1 gate) pick slices != dp. Scalar bookkeeping psums below
-    ``min_payload_bytes`` are ignored."""
+    The group-signature heuristic itself now lives in the axis-algebra
+    planner (``axis_algebra.MeshFactorization.classify_group`` — stated
+    once for audits AND the collective_placement lint); this function
+    is the list-level wrapper audits call. Ambiguous when slices == dp
+    (raises); callers (tools/comm_audit.py, the tier-1 gate) pick
+    slices != dp. Scalar bookkeeping psums below ``min_payload_bytes``
+    are ignored."""
+    from .axis_algebra import MeshFactorization
+    fact = MeshFactorization.from_sizes(slice=num_slices, data=dp)
+    fact.classify_group(dp)     # raise the ambiguity eagerly, ops or not
     out: Dict[str, List[Any]] = {"ici": [], "dcn": [], "flat": [],
                                  "other": []}
-    if num_slices == dp:
-        raise ValueError(
-            "two-tier classification by group signature is ambiguous "
-            f"when slices == dp (= {dp}); audit on a mesh with "
-            "slices != dp")
     for o in ops:
         if o.payload_bytes < min_payload_bytes:
             continue
-        if o.group_size == num_slices * dp:
-            out["flat"].append(o)
-        elif o.group_size == dp:
-            out["ici"].append(o)
-        elif o.group_size == num_slices:
-            out["dcn"].append(o)
-        else:
-            out["other"].append(o)
+        out[fact.classify_group(o.group_size)].append(o)
     return out
 
 

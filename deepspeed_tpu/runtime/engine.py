@@ -682,6 +682,7 @@ class DeepSpeedEngine:
                 wire_bytes_ici=self._wire_bytes - self._wire_bytes_dcn,
                 wire_bytes_dcn=self._wire_bytes_dcn,
                 dcn_compression=self._dcn_compression,
+                wire_terms=self._wire_terms(),
                 wire_detail=self._wire_detail,
                 train_batch_size=self.train_batch_size(),
                 gradient_accumulation_steps=
@@ -834,23 +835,31 @@ class DeepSpeedEngine:
                     f"train path only; drop {', '.join(blockers)}")
         if self.slice_size > 1:
             # Multi-slice scale-out composes with the MAIN train path on
-            # a (slice, data) mesh under ZeRO stage >= 2 only: the
+            # a (slice, data) mesh under ZeRO stage >= 2 (stages 2 AND
+            # 3: the axis-algebra planner places the stage-3 param
+            # gathers on `data`/ICI and only the 1/dp residual on DCN).
+            # Each remaining refusal is the planner-derived reason: the
             # hierarchical sync's DCN saving IS the in-slice reduce-
-            # scatter (1/dp of the grads cross slices) — dense modes
-            # would ship grad-sized trees over DCN and every other path
-            # computes grads without the slice axis in scope (silently
-            # missing the inter-slice reduction entirely).
+            # scatter (dense modes would ship grad-sized trees over
+            # DCN), and every other path computes grads without the
+            # slice axis in scope (silently missing the inter-slice
+            # reduction entirely).
+            from ..parallel.axis_algebra import MeshFactorization
             blockers = []
             if self.zero_optimization_stage() < 2:
                 blockers.append("zero_optimization.stage >= 2 (got "
-                                f"{self.zero_optimization_stage()})")
+                                f"{self.zero_optimization_stage()}; the "
+                                "planner's in-slice tier is a reduce-"
+                                "scatter — dense grads have no 1/dp "
+                                "residual to confine to DCN)")
             if not self.config.zero_config.reduce_scatter:
                 blockers.append("reduce_scatter: true")
-            if self.zero_optimization_stage() >= 3:
-                blockers.append("stage <= 2 (stage-3 x multislice not "
-                                "composed yet)")
-            if self.ep_size > 1:
-                blockers.append("expert_parallel_size == 1")
+            try:
+                MeshFactorization.from_mesh(self.mesh).outer_axis
+            except ValueError as e:
+                # slice x expert: the planner supports one outer
+                # residual axis — quote its reason verbatim.
+                blockers.append(f"expert_parallel_size == 1 ({e})")
             if self._direct_grads_fn is not None:
                 blockers.append("no pipeline grads_fn (1F1B)")
             if self.config.zero_config.cpu_offload:
@@ -1060,32 +1069,45 @@ class DeepSpeedEngine:
             return 0, "single replica (no gradient sync)"
         from ..parallel import hlo_audit
         if self.slice_size > 1:
+            gas = self._scan_microbatches()
+            zero3_kw = {}
+            if self._zero3:
+                zero3_kw = dict(
+                    zero3=True,
+                    param_bytes_per_el=jnp.dtype(
+                        self.compute_dtype).itemsize,
+                    gas=gas, param_specs=self._stage3_specs,
+                    mesh=self.mesh)
             model = hlo_audit.grad_sync_wire_model(
                 self.state.params, self.dp_size, slices=self.slice_size,
-                dcn_compression=self._dcn_compression)
+                dcn_compression=self._dcn_compression, **zero3_kw)
             self._wire_model = model
             dcn = model["dcn_wire_bytes_compressed"] \
                 if self._dcn_compression else model["dcn_wire_bytes"]
             self._wire_bytes_dcn = int(dcn)
             # The tiers are per-STEP in the same units: the in-slice
-            # scatter runs once per micro-step inside the gas scan
+            # collectives run once per micro-step inside the gas scan
             # (x gas), the DCN hop once per step on the accumulated
             # shard — summing a per-micro ICI term with a per-step DCN
-            # term would misreport which tier binds.
-            gas = self._scan_microbatches()
+            # term would misreport which tier binds. Under stage 3 the
+            # ici term already includes both param gathers (the planner
+            # binds them to `data`: ICI on every factorization).
             ici = int(model["ici_wire_bytes"]) * int(gas)
             comp = (" 1-bit-compressed (packed sign bits + per-chunk "
                     "scales — the DCN wire format; the emulation psums "
                     "decompressed values)") if self._dcn_compression \
                 else ""
+            z3 = (f" + 2 in-slice param gathers/micro-step "
+                  f"({jnp.dtype(self.compute_dtype).name} wire, zero "
+                  f"param bytes on DCN)") if self._zero3 else ""
             return int(ici + dcn), \
                 (f"hierarchical {self._grad_sync_mode}: in-slice "
                  f"reduce-scatter over ICI (dp={self.dp_size}, "
-                 f"x{gas} micro-steps) + inter-slice all-reduce over "
-                 f"DCN (slices={self.slice_size}) of the 1/dp residual "
-                 f"only{comp} — {int(dcn):,} DCN B/step vs "
-                 f"{model['flat_dcn_link_bytes']:,} grad-sized for a "
-                 f"flat joint sync")
+                 f"x{gas} micro-steps){z3} + inter-slice all-reduce "
+                 f"over DCN (slices={self.slice_size}) of the 1/dp "
+                 f"residual only{comp} — {int(dcn):,} DCN B/step vs "
+                 f"{model['flat_dcn_link_bytes']:,} for a flat joint "
+                 f"sync")
         if self.ep_size > 1:
             return self._moe_wire_bytes(hlo_audit)
         if self._sparse_mask is not None:
@@ -1143,6 +1165,32 @@ class DeepSpeedEngine:
         return model["reduce_scatter_wire_bytes"], \
             (f"{mode} reduce-scatter (declared sharding "
              f"lowers to {declared} on this backend)")
+
+    def _wire_terms(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        """Per-TERM split of the analytic wire figure on a multi-slice
+        mesh, each term tagged with the tier it rides (the planner's
+        assignment): the in-scan grad reduce-scatter and — under stage 3
+        — both param gathers on ICI, the once-per-step residual
+        all-reduce on DCN. None on single-slice meshes (one tier, no
+        split to report). Telemetry meta carries it so the roofline's
+        comm_tiers can be decomposed per collective, not just per tier."""
+        wm = self._wire_model
+        if not isinstance(wm, dict) or "ici_wire_bytes" not in wm:
+            return None
+        gas = int(self._scan_microbatches())
+        rs = int(wm["reduce_scatter_wire_bytes"]) * gas
+        terms = {
+            "grad_reduce_scatter": {"tier": "ici", "bytes": rs,
+                                    "placement": "in-scan"},
+            "inter_slice_residual": {"tier": "dcn",
+                                     "bytes": int(self._wire_bytes_dcn),
+                                     "placement": "per-step"},
+        }
+        gather = int(wm["ici_wire_bytes"]) * gas - rs
+        if gather > 0:
+            terms["param_gather"] = {"tier": "ici", "bytes": gather,
+                                     "placement": "in-scan"}
+        return terms
 
     def _moe_layer_info(self) -> Tuple[int, int]:
         """(n_moe_layers, hidden) read off the expert up-projection leaf
@@ -1283,6 +1331,8 @@ class DeepSpeedEngine:
             tl.meta["wire_bytes_per_step"] = self._wire_bytes
             tl.meta["wire_bytes_ici"] = \
                 self._wire_bytes - self._wire_bytes_dcn
+            tl.meta["wire_bytes_dcn"] = self._wire_bytes_dcn
+            tl.meta["wire_terms"] = self._wire_terms()
             tl.meta["wire_detail"] = self._wire_detail
             if isinstance(self._wire_model, dict) and \
                     "moe" in self._wire_model:
@@ -1441,13 +1491,25 @@ class DeepSpeedEngine:
         if getattr(self, "_dcn_compression", False) and \
                 self.slice_size > 1:
             from .zero.partition import _leaf_spec
+            # Under stage 3 the error leaf must mirror the STAGE-3 grad
+            # spec (covered scanned leaves keep their layer axis
+            # unsharded — the plain rule would disagree with the
+            # builder's err_specs and force a reshard at the shard_map
+            # boundary every step).
+            z3_specs = self._stage3_specs \
+                if getattr(self, "_zero3", False) else None
 
-            def err_sharding(p):
+            def err_sharding(p, sp=None):
                 if not hasattr(p, "shape") or getattr(p, "ndim", 0) < 1:
                     return NamedSharding(self.mesh, P(SLICE_AXIS))
-                spec = _leaf_spec(p.shape, self.dp_size, DP_AXIS)
+                spec = sp if sp is not None \
+                    else _leaf_spec(p.shape, self.dp_size, DP_AXIS)
                 return NamedSharding(self.mesh, P(SLICE_AXIS, *spec))
-            dcn_sh = jax.tree_util.tree_map(err_sharding, params)
+            if z3_specs is not None:
+                dcn_sh = jax.tree_util.tree_map(
+                    err_sharding, params, z3_specs)
+            else:
+                dcn_sh = jax.tree_util.tree_map(err_sharding, params)
         return EngineState(step=scalar, params=params_sh, opt_state=opt_sh,
                            loss_scale=scalar, growth_count=scalar,
                            hysteresis=scalar, skipped_steps=scalar,
@@ -2291,7 +2353,13 @@ class DeepSpeedEngine:
         collective, so one stage-3 step is bit-identical to the stage-2
         step from the same state. Leaves a bound ``Zero3Scan`` covers
         pass through as shards: the model gathers them per layer inside
-        its scan, prefetch_depth layers ahead.
+        its scan, prefetch_depth layers ahead. On a MULTI-SLICE mesh
+        stage 3 composes by the same algebra: the stage-3 specs shard
+        over `data` only, so each slice holds the full shard set
+        replicated across slices, every gather_cast / layer-scan gather
+        binds `data` (ICI — zero param bytes ever cross DCN), the
+        in-vjp scatter is the in-slice tier, and the accumulated 1/dp
+        residual takes the same once-per-step DCN hop as stage 2.
 
         Parity with the declarative path (tests/test_hlo_audit.py): one
         step from identical state is BIT-identical — the local per-rank
@@ -2310,22 +2378,30 @@ class DeepSpeedEngine:
         new_dcn_error)`` — ``new_dcn_error`` is None unless DCN
         compression is live.
         """
+        from ..parallel.axis_algebra import (MeshFactorization,
+                                             plan_grad_sync)
         from ..parallel.multislice import inter_slice_allreduce
         shard_map = comm.shard_map
         mesh, dp = self.mesh, self.dp_size
         accepts_pld = self._accepts_pld
         zero3 = self._zero3
-        # The factored outer replica axis (None on a plain-dp mesh):
-        # `slice` (multi-slice, DCN tier) or `expert` (MoE groups).
-        if self.slice_size > 1:
-            outer_axis, outer = SLICE_AXIS, self.slice_size
-        elif self.ep_size > 1:
-            outer_axis, outer = EP_AXIS, self.ep_size
-        else:
-            outer_axis, outer = None, 1
-        replicas = dp * outer
+        # The collective schedule is DERIVED from the mesh factorization
+        # (parallel/axis_algebra): the single outer replica axis (None
+        # on a plain-dp mesh — `slice` rides DCN, `expert` stays ICI),
+        # the full replica count, the shard_map scope, and where each
+        # collective sits. The lax calls below execute that plan; the
+        # wire model prices it; lint/audit check the compiled program
+        # against it.
+        fact = MeshFactorization.from_mesh(mesh)
+        plan = plan_grad_sync(fact, zero3=zero3,
+                              dcn_compression=self._dcn_compression)
+        outer_axis = fact.outer_axis
+        outer = fact.size(outer_axis) if outer_axis is not None else 1
+        replicas = fact.replicas
         moe_manual = self.ep_size > 1
-        dcn_compress = self._dcn_compression and outer_axis == SLICE_AXIS
+        dcn_compress = (self._dcn_compression
+                        and plan.residual is not None
+                        and plan.residual.tier == "dcn")
         leaves, treedef = jax.tree_util.tree_flatten(grad_sh)
         dims_tree = jax.tree_util.tree_unflatten(
             treedef, [_spec_axis(sh, DP_AXIS) for sh in leaves])
@@ -2532,7 +2608,7 @@ class DeepSpeedEngine:
                 return g, loss, reduce_aux(aux), new_err
             return g, loss, reduce_aux(aux)
 
-        batch_axes = (outer_axis, DP_AXIS) if outer_axis is not None \
+        batch_axes = fact.grad_shard_scope if outer_axis is not None \
             else DP_AXIS
         err_specs = jax.tree_util.tree_unflatten(
             treedef, [P(SLICE_AXIS, *sh.spec) for sh in leaves]) \
@@ -3302,6 +3378,44 @@ class DeepSpeedEngine:
                     dcn_shard_bytes.add(n // self.dp_size * 4)
                 else:
                     dcn_shard_bytes.add(n * 4)
+        # Stage 3: the per-leaf GATHERED payload sizes (full leaf at the
+        # wire dtypes, plus the per-layer slice for scanned leaves) — on
+        # a multislice mesh collective_placement flags any all-gather of
+        # one whose groups are wider than dp (param bytes over DCN; the
+        # planner binds every stage-3 gather to `data`/ICI).
+        z3_gather_leaf: set = set()
+        if self._zero3 and self.dp_size > 1:
+            from .zero.partition import spec_dp_dim
+            wire_itemsize = int(jnp.dtype(self.compute_dtype).itemsize)
+            leaves = jax.tree_util.tree_leaves(self.state.params)
+            spec_l = jax.tree_util.tree_structure(
+                self.state.params).flatten_up_to(self._stage3_specs)
+            cov_l = jax.tree_util.tree_leaves(self._zero3_covered)
+            for l, sp, cov in zip(leaves, spec_l, cov_l):
+                if not hasattr(l, "shape"):
+                    continue
+                if spec_dp_dim(sp, DP_AXIS) is None:
+                    continue
+                n = int(l.size)
+                for b in (wire_itemsize, 4):
+                    z3_gather_leaf.add(n * b)
+                    if cov and getattr(l, "ndim", 0) >= 1 and \
+                            int(l.shape[0]) > 0:
+                        z3_gather_leaf.add(n // int(l.shape[0]) * b)
+        # The derived collective schedule (axis_algebra) the explicit
+        # path executes — serialized for lint/audit consumers.
+        plan_meta = None
+        if getattr(self, "_grad_sync_mode", "none") == "explicit" and \
+                self.replica_size > 1:
+            from ..parallel.axis_algebra import (MeshFactorization,
+                                                 plan_grad_sync)
+            try:
+                plan_meta = plan_grad_sync(
+                    MeshFactorization.from_mesh(self.mesh),
+                    zero3=bool(self._zero3),
+                    dcn_compression=bool(self._dcn_compression)).to_meta()
+            except ValueError:
+                plan_meta = None
         return {
             "grad_sync_path": name in grad_paths,
             "grad_sync_mode": getattr(self, "_grad_sync_mode", "none"),
@@ -3321,6 +3435,8 @@ class DeepSpeedEngine:
             "zero_stage": self.zero_optimization_stage(),
             "zero3": bool(self._zero3),
             "zero3_gather_bytes": int(gather_ws),
+            "zero3_gather_leaf_bytes": sorted(z3_gather_leaf),
+            "collective_plan": plan_meta,
         }
 
     def lint_audit(self, config=None, waivers=None, passes=None):

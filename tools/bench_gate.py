@@ -57,7 +57,13 @@ never fail. Multislice rounds (a ``multislice`` record in
 MULTISLICE_BENCH.json, or a TELEMETRY.json roofline ``comm_tiers``
 section) gate DCN bytes/step on a RELATIVE rise beyond ``--dcn-rise``
 (default 10%) — the slow tier is the scale-out ceiling; pre-multislice
-rounds skip, never fail. Resilience rounds (a ``checkpoint`` record in
+rounds skip, never fail. Stage-3-across-slices rounds (a ``zero3``
+record with ``dcn_bytes_per_step`` in MULTISLICE_BENCH.json, from
+``ablate_multislice.py --zero3``) gate the hierarchical schedule's DCN
+bytes/step on the same relative rise, and the DCN *param* bytes/step
+against a relative ceiling over the planner's structural 0 — any param
+byte leaking onto the slow tier fails; pre-composition rounds skip,
+never fail. Resilience rounds (a ``checkpoint`` record in
 RESILIENCE_BENCH.json from ``tools/crashkill.py bench``, or a
 TELEMETRY.json goodput section carrying a ``checkpoint`` sub-dict with
 nonzero exposed wall) gate the checkpoint-EXPOSED goodput share on the
@@ -105,6 +111,21 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
     z3 = doc.get("zero3")
     if isinstance(z3, dict) and z3.get("overlap_fraction") is not None:
         zero3_overlap = float(z3["overlap_fraction"])
+    # MULTISLICE_BENCH.json's `zero3` record (ablate_multislice.py
+    # --zero3): stage-3-across-slices DCN figures under the planner's
+    # hierarchical schedule. Two gated numbers: total DCN bytes/step
+    # (regression = RISE, same rule as the stage-2 multislice gate) and
+    # the PARAM bytes on DCN — structurally zero under the planner, so
+    # the relative ceiling over an old value of 0 is 0 and ANY param
+    # byte that leaks onto the slow tier fails the round. Pre-
+    # composition rounds carry no record -> skipped, never failed.
+    z3_dcn_bytes: Optional[float] = None
+    z3_dcn_param: Optional[float] = None
+    if isinstance(z3, dict) and z3.get("available", True):
+        if z3.get("dcn_bytes_per_step") is not None:
+            z3_dcn_bytes = float(z3["dcn_bytes_per_step"])
+        if z3.get("dcn_param_bytes_per_step") is not None:
+            z3_dcn_param = float(z3["dcn_param_bytes_per_step"])
     # DS_BENCH_KERNELS ablation record: the fused-over-unfused step
     # speedup (bench.py bench_kernels_ablation / ablate_fused_ln.py).
     krn = doc.get("kernels")
@@ -224,6 +245,7 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "ttft_p95": ttft_p95, "kernel_speedup": kernel_speedup,
             "tile_speedup": tile_speedup,
             "zero3_overlap": zero3_overlap, "health": health,
+            "z3_dcn_bytes": z3_dcn_bytes, "z3_dcn_param": z3_dcn_param,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
             "attend_ratio": attend_ratio,
             "moe_drop": moe_drop, "dcn_bytes": dcn_bytes,
@@ -452,6 +474,43 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
                    if m["dcn_bytes"] is None]
         print(f"multislice dcn bytes/step: skipped (no multislice "
               f"record in {', '.join(missing)})")
+
+    if old["z3_dcn_bytes"] is not None and \
+            new["z3_dcn_bytes"] is not None:
+        compared += 1
+        ceil = old["z3_dcn_bytes"] * (1.0 + dcn_rise)
+        verdict = "OK" if new["z3_dcn_bytes"] <= ceil else "REGRESSION"
+        print(f"zero3 multislice dcn bytes/step: {name_old}="
+              f"{old['z3_dcn_bytes']:.4g}B -> "
+              f"{name_new}={new['z3_dcn_bytes']:.4g}B "
+              f"(ceiling {ceil:.4g}B, +{dcn_rise:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-composition (stage-3 x slices) rounds skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["z3_dcn_bytes"] is None]
+        print(f"zero3 multislice dcn bytes/step: skipped (no zero3 "
+              f"record in {', '.join(missing)})")
+
+    if old["z3_dcn_param"] is not None and \
+            new["z3_dcn_param"] is not None:
+        compared += 1
+        # Relative ceiling over the planner's structural 0 is 0: a
+        # single param byte leaking onto DCN fails the round.
+        ceil = old["z3_dcn_param"] * (1.0 + dcn_rise)
+        verdict = "OK" if new["z3_dcn_param"] <= ceil else "REGRESSION"
+        print(f"zero3 multislice dcn PARAM bytes/step: {name_old}="
+              f"{old['z3_dcn_param']:.4g}B -> "
+              f"{name_new}={new['z3_dcn_param']:.4g}B "
+              f"(ceiling {ceil:.4g}B, +{dcn_rise:.0%} rel): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["z3_dcn_param"] is None]
+        print(f"zero3 multislice dcn PARAM bytes/step: skipped (no "
+              f"zero3 record in {', '.join(missing)})")
 
     if old["moe_drop"] is not None and new["moe_drop"] is not None:
         compared += 1

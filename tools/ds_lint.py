@@ -31,6 +31,12 @@ Flagships (the engine modes whose compiled programs differ):
   INSIDE the gas scan, only the 1/dp residual all-reduces across
   slices, and collective_placement's slice check gates that nothing
   grad-sized spans the slice axis (a flat joint sync over DCN)
+- **zero3_multislice** — ZeRO-3 across slices (slices=2 x dp=4,
+  gas=2) via the axis-algebra planner: params born dp-sharded within
+  each slice, every param gather binds `data` (ICI only), one
+  residual all-reduce across slices; collective_placement gates both
+  grad-spans-dcn and the param-spans-dcn check (a param-sized gather
+  over the joint (slice, data) group)
 - **serving** — the inference tier's paged compiled paths (gpt2-tiny,
   continuous batching over the block pool): group-batched chunked
   prefill, plain decode, the speculative verify step, and the
@@ -325,6 +331,19 @@ def build_multislice():
                                   "mesh": {"slices": 2}}, gas=2)
 
 
+def build_zero3_multislice():
+    # ISSUE 18: ZeRO-3 across slices via the axis-algebra planner —
+    # params born dp-sharded within each slice, gathers bind `data`
+    # (ICI only), the residual all-reduce is the single inter-slice
+    # exchange. collective_placement's slice-tier checks gate BOTH
+    # directions: grad-spans-dcn (flat joint grad sync) and the new
+    # param-spans-dcn (a param-sized gather whose groups span `slice`
+    # — its seeded violation lives in tests/test_multislice.py).
+    return _engine("zero3_multislice",
+                   {"zero_optimization": {"stage": 3},
+                    "mesh": {"slices": 2}}, gas=2)
+
+
 FLAGSHIPS = {
     "zero1": build_zero1,
     "zero2": build_zero2,
@@ -335,6 +354,7 @@ FLAGSHIPS = {
     "serving": build_serving,
     "moe": build_moe,
     "multislice": build_multislice,
+    "zero3_multislice": build_zero3_multislice,
 }
 
 
