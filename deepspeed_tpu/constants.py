@@ -349,6 +349,23 @@ INFERENCE_REPLICA_DEFAULT = ""
 # (block_size == 0).
 INFERENCE_PAGED_KERNEL = "paged_kernel"
 INFERENCE_PAGED_KERNEL_DEFAULT = "auto"
+# inference.slo — serving SLO targets (monitor/serving_slo.py). A
+# request is "good" when its TTFT and TPOT are both inside target; an
+# unset target (0) always passes, and with both unset the tracker is
+# off (snapshots omit the slo section). availability is the target
+# good-fraction whose complement is the error budget the burn rate is
+# measured against (burn_rate > 1 = budget consumed faster than the
+# SLO allows); window_s is the trailing window for the windowed
+# attainment/burn view.
+INFERENCE_SLO = "slo"
+INFERENCE_SLO_TTFT_MS = "ttft_ms"
+INFERENCE_SLO_TTFT_MS_DEFAULT = 0.0
+INFERENCE_SLO_TPOT_MS = "tpot_ms"
+INFERENCE_SLO_TPOT_MS_DEFAULT = 0.0
+INFERENCE_SLO_AVAILABILITY = "availability"
+INFERENCE_SLO_AVAILABILITY_DEFAULT = 0.99
+INFERENCE_SLO_WINDOW_S = "window_s"
+INFERENCE_SLO_WINDOW_S_DEFAULT = 60.0
 
 #############################################
 # ZeRO

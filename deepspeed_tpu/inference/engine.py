@@ -50,6 +50,7 @@ from ..models.gpt2 import GPT2Config
 from ..monitor import Telemetry
 from ..monitor.memory import analytic_state_bytes
 from ..monitor.serving import ServingAggregator
+from ..monitor.serving_slo import ServingGoodputLedger, SLOTracker
 from ..ops import paged_attention as paged_attn_ops
 from ..parallel.topology import build_mesh, DP_AXIS, MP_AXIS, SP_AXIS
 from ..runtime.config import InferenceConfig, TelemetryConfig
@@ -185,12 +186,19 @@ class InferenceEngine:
         self.active = np.zeros(self.max_slots, bool)
         self.last_tokens = np.zeros(self.max_slots, np.int32)
         self._held = set()               # acquired, not yet activated
+        self._last_admit: Dict[int, Dict[str, Any]] = {}
+        # Why the most recent select_slot returned None ("no_slot" =
+        # every slot busy; "reservation" = slots free but the block-pool
+        # gate refused the HBM booking). Host state for the scheduler's
+        # rejection accounting.
+        self.last_admit_block: Optional[str] = None
 
         # --- telemetry on the shared spine ---
         self.iterations = 0
         self._rng_calls = 0
         self.serving = ServingAggregator(self.max_slots,
                                          label=self.replica or None)
+        self._attach_slo_overlays()
         tel_meta = dict(mode="serving", model=model_cfg.name,
                         dp=self.dp, mp=self.mp, sp=self.sp,
                         max_slots=self.max_slots, max_seq_len=self.max_len,
@@ -467,9 +475,11 @@ class InferenceEngine:
         ``exclude_groups`` lets the scheduler gather a one-slot-per-
         group admission batch for ``prefill_many``."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.last_admit_block = None
         free = [s for s in range(self.max_slots)
                 if not self.active[s] and s not in self._held]
         if not free:
+            self.last_admit_block = "no_slot"
             return None
         if not self.paged:
             self._held.add(free[0])
@@ -496,7 +506,27 @@ class InferenceEngine:
                 best, best_key = s, key
         if best is not None:
             self._held.add(best)
+        else:
+            self.last_admit_block = "reservation"
         return best
+
+    def last_admit_info(self, slot: int) -> Dict[str, Any]:
+        """Prefix-cache/CoW detail of the most recent admission into
+        ``slot`` (for the request trace); empty for slot-major paths."""
+        return self._last_admit.get(slot, {})
+
+    def note_admission_reject(self, rid: Any, reason: str, attempt: int,
+                              queue_depth: int = 0) -> None:
+        """Count one admission rejection; the FIRST rejection of each
+        request also writes a structured telemetry event (the retry loop
+        used to be invisible in the stream)."""
+        self.serving.note_reject()
+        if attempt == 1 and self.telemetry.enabled:
+            payload = {"rid": rid, "reason": reason,
+                       "queue_depth": int(queue_depth)}
+            if self.replica:
+                payload["replica"] = self.replica
+            self.telemetry.event("admission_rejected", payload)
 
     def prefix_match_tokens(self, prompt: Sequence[int]) -> int:
         """Longest cached prompt prefix (tokens) resident anywhere in
@@ -532,6 +562,7 @@ class InferenceEngine:
         request's) books the worst-case HBM reservation so mid-flight
         appends can never strand the slot. Direct calls without it
         reserve nothing and draw from the free pool lazily."""
+        t0 = time.perf_counter()
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         plen = int(prompt.shape[0])
         if plen < 1:
@@ -594,7 +625,10 @@ class InferenceEngine:
         self.telemetry.raise_pending()
         out_logits = np.asarray(jax.device_get(logits)) \
             if return_logits else None
-        return int(jax.device_get(tok)), out_logits
+        tok = int(jax.device_get(tok))
+        if self.serving.ledger is not None:
+            self.serving.ledger.note("prefill", time.perf_counter() - t0)
+        return tok, out_logits
 
     def prefill_many(self, admissions: Sequence[Tuple[int, Any, int]],
                      temperature: float = 0.0,
@@ -615,6 +649,7 @@ class InferenceEngine:
         if not (self.paged and self.prefill_chunk > 0):
             raise RuntimeError("prefill_many needs the paged cache and "
                                "chunked prefill")
+        t_pf0 = time.perf_counter()
         G = self.dp
         J = self.cache_spec.max_blocks_per_slot
         Sg = self.cache_spec.slots_per_group
@@ -664,6 +699,9 @@ class InferenceEngine:
             padded = np.zeros(n_chunks * chunk, np.int32)
             padded[:tlen] = prompt[plan.matched:]
             tails.append((padded, n_chunks, tlen))
+            self._last_admit[slot] = {
+                "cached_tokens": int(plan.matched), "chunks": n_chunks,
+                "cow_fork": plan.cow_src is not None}
         max_chunks = max(n for _, n, _ in tails)
         held = {}                       # slot -> (ci, group) of its last chunk
         steps = []                      # per-ci (tok_g, logits_g) device arrays
@@ -700,6 +738,9 @@ class InferenceEngine:
                 self.drafter.begin(slot, prompt)
             self.serving.note_admit(plen, plan.matched)
             out.append((tok, logits))
+        if self.serving.ledger is not None:
+            self.serving.ledger.note("prefill",
+                                     time.perf_counter() - t_pf0)
         return out
 
     def _cache_accounting(self) -> Tuple[int, int]:
@@ -776,6 +817,8 @@ class InferenceEngine:
         self.serving.note_iteration(n_active, wall,
                                     cache_bytes=cache_bytes,
                                     context_tokens=ctx_tokens)
+        if self.serving.ledger is not None:
+            self.serving.ledger.note("decode_useful", wall)
         if self.paged and n_active:
             self.serving.note_attend(*self._attend_work(1), n_active)
         tl = self.telemetry
@@ -854,6 +897,16 @@ class InferenceEngine:
                                     cache_bytes=cache_bytes,
                                     context_tokens=ctx_tokens,
                                     emitted_tokens=emitted_total)
+        if self.serving.ledger is not None:
+            # Split the verify wall by row share: of the (k+1) verify
+            # rows per live slot, the emitted tokens (accepted drafts +
+            # the correction/bonus) are useful work; the rejected drafts
+            # are wall the draft caused and the target threw away.
+            rows = (k + 1) * len(live)
+            wasted = wall * (k * len(live) - accepted) / rows \
+                if rows else 0.0
+            self.serving.ledger.note("spec_wasted", wasted)
+            self.serving.ledger.note("decode_useful", wall - wasted)
         if n_active and emitted_total:
             self.serving.note_attend(*self._attend_work(k + 1),
                                      emitted_total)
@@ -869,6 +922,17 @@ class InferenceEngine:
             tl.maybe_drain(self.iterations, extra_fn=self._report_extra)
         return emitted, n_new
 
+    def _attach_slo_overlays(self) -> None:
+        """Attach the serving goodput ledger (always — host arithmetic)
+        and, when ``inference.slo`` sets a target, the SLO tracker."""
+        self.serving.ledger = ServingGoodputLedger(
+            label=self.replica or None)
+        scfg = self.icfg.slo
+        if scfg.enabled:
+            self.serving.slo = SLOTracker(
+                ttft_ms=scfg.ttft_ms, tpot_ms=scfg.tpot_ms,
+                availability=scfg.availability, window_s=scfg.window_s)
+
     def reset_serving_stats(self) -> None:
         """Fresh aggregator window (benches call this after a warmup
         pass so compile time never pollutes the measured TTFT/TPOT
@@ -878,6 +942,7 @@ class InferenceEngine:
         if self.paged:
             self.serving.attend_mode = ("kernel" if self.paged_kernel
                                         else "onehot")
+        self._attach_slo_overlays()
         self._spec_proposed = 0
         self._spec_accepted = 0
 
@@ -886,20 +951,46 @@ class InferenceEngine:
 
     def complete_request(self, rid: Any, ttft_s: float,
                          tpot_s: Optional[float], prompt_tokens: int,
-                         new_tokens: int) -> None:
+                         new_tokens: int,
+                         queue_wait_s: Optional[float] = None,
+                         service_ttft_s: Optional[float] = None,
+                         admission_attempts: Optional[int] = None) -> None:
         """Per-request goodput accounting at completion (host clocks
-        only): feeds the aggregator and writes a ``request_complete``
-        telemetry event."""
-        self.serving.note_request(ttft_s, tpot_s, new_tokens)
+        only): feeds the aggregator and SLO tracker and writes a
+        ``request_complete`` telemetry event. ``queue_wait_s`` /
+        ``service_ttft_s`` split the TTFT at the admission instant."""
+        self.serving.note_request(ttft_s, tpot_s, new_tokens,
+                                  queue_wait_s=queue_wait_s,
+                                  service_ttft_s=service_ttft_s,
+                                  admission_attempts=admission_attempts)
+        if self.serving.slo is not None:
+            self.serving.slo.observe(ttft_s, tpot_s)
         if self.telemetry.enabled:
             payload = {"rid": rid, "ttft_ms": round(ttft_s * 1e3, 3),
                        "prompt_tokens": int(prompt_tokens),
                        "new_tokens": int(new_tokens)}
             if tpot_s is not None:
                 payload["tpot_ms"] = round(tpot_s * 1e3, 3)
+            if queue_wait_s is not None:
+                payload["queue_wait_ms"] = round(queue_wait_s * 1e3, 3)
+            if service_ttft_s is not None:
+                payload["service_ttft_ms"] = round(service_ttft_s * 1e3, 3)
+            if admission_attempts:
+                payload["admission_attempts"] = int(admission_attempts)
             if self.replica:
                 payload["replica"] = self.replica
             self.telemetry.event("request_complete", payload)
+
+    def abort_request(self, rid: Any, reason: str = "abort") -> None:
+        """An aborted/evicted request: counts against SLO availability
+        and leaves a structured event in the stream."""
+        if self.serving.slo is not None:
+            self.serving.slo.observe_failure()
+        if self.telemetry.enabled:
+            payload = {"rid": rid, "reason": reason}
+            if self.replica:
+                payload["replica"] = self.replica
+            self.telemetry.event("request_abort", payload)
 
     def serve(self, requests, temperature: float = 0.0, **kwargs):
         """Drive a request list/stream through the continuous-batching

@@ -56,29 +56,60 @@ class ReplicaRouter:
         self.max_wall_s = max_wall_s
         self.routed: List[int] = [0] * len(self.engines)
         self.affinity_hits = 0
+        # Per-request decision records: every candidate's occupancy /
+        # queue depth / affinity tokens / composite score at route time
+        # (ring-capped). The chosen replica is argmax of the recorded
+        # scores BY CONSTRUCTION — the test gate replays them.
+        self.decisions: List[dict] = []
+        self.decision_capacity = 4096
+        self.trace = None               # RequestTrace, built in serve()
 
     # ------------------------------------------------------------------ #
-    def _score(self, eng, queue_len: int, req: Request) -> float:
-        """Higher is better: prefix affinity minus load (occupancy +
-        normalized queue depth)."""
+    def _score_parts(self, eng, queue_len: int, req: Request) -> dict:
+        """One candidate's routing signals (all host counters): higher
+        composite score is better — prefix affinity minus load
+        (occupancy + normalized queue depth)."""
         plen = max(len(req.prompt), 1)
-        affinity = eng.prefix_match_tokens(req.prompt) / plen
-        load = (eng.active_slots / eng.max_slots
-                + queue_len / eng.max_slots)
-        return self.affinity_weight * affinity - load
+        affinity_tokens = eng.prefix_match_tokens(req.prompt)
+        occupancy = eng.active_slots / eng.max_slots
+        queue_load = queue_len / eng.max_slots
+        return {
+            "occupancy": round(occupancy, 4),
+            "queue_depth": queue_len,
+            "affinity_tokens": affinity_tokens,
+            "score": self.affinity_weight * (affinity_tokens / plen)
+            - (occupancy + queue_load),
+        }
+
+    def _score(self, eng, queue_len: int, req: Request) -> float:
+        return self._score_parts(eng, queue_len, req)["score"]
 
     def route(self, req: Request, queues: List[deque]) -> int:
         """Pick the admitting replica for one request (called once, at
-        arrival — affinity is sticky by construction afterwards)."""
-        scores = [self._score(eng, len(queues[i]), req)
-                  for i, eng in enumerate(self.engines)]
+        arrival — affinity is sticky by construction afterwards). The
+        full candidate table is recorded so every choice is explainable
+        after the fact."""
+        cands = []
+        for i, eng in enumerate(self.engines):
+            parts = self._score_parts(eng, len(queues[i]), req)
+            parts["replica"] = i
+            label = getattr(eng, "replica", None)
+            if label:
+                parts["label"] = label
+            cands.append(parts)
+        scores = [c["score"] for c in cands]
         best = int(np.argmax(scores))
-        plain = [-(eng.active_slots / eng.max_slots
-                   + len(queues[i]) / eng.max_slots)
-                 for i, eng in enumerate(self.engines)]
+        plain = [-(c["occupancy"] + c["queue_depth"]
+                   / self.engines[i].max_slots)
+                 for i, c in enumerate(cands)]
         if best != int(np.argmax(plain)):
             self.affinity_hits += 1     # affinity overrode pure load
         self.routed[best] += 1
+        decision = {"rid": req.rid, "chosen": best, "candidates": cands}
+        if len(self.decisions) < self.decision_capacity:
+            self.decisions.append(decision)
+        if self.trace is not None:
+            self.trace.route(req.rid, best, cands)
         return best
 
     # ------------------------------------------------------------------ #
@@ -94,6 +125,20 @@ class ReplicaRouter:
         replica_of: Dict[int, int] = {}
         spec = [bool(getattr(e, "spec_enabled", False))
                 and self.temperature == 0.0 for e in self.engines]
+        # One shared request trace for the fleet: the route decision and
+        # the replica-side spans land in the same record; each finished
+        # request drains into ITS replica's telemetry stream.
+        if self.trace is None and any(e.telemetry.enabled
+                                      for e in self.engines):
+            from ..monitor.request_trace import RequestTrace
+            self.trace = RequestTrace()
+        trace = self.trace
+
+        def label(i: int) -> str:
+            return getattr(self.engines[i], "replica", "") or f"r{i}"
+
+        def ledger_of(eng):
+            return getattr(eng.serving, "ledger", None)
 
         def finished(req: Request, eng, slot: int) -> bool:
             if len(req.out_tokens) >= req.max_new_tokens:
@@ -106,20 +151,42 @@ class ReplicaRouter:
         def complete(req: Request, eng) -> None:
             eng.complete_request(req.rid, req.ttft_s or 0.0, req.tpot_s,
                                  prompt_tokens=len(req.prompt),
-                                 new_tokens=len(req.out_tokens))
+                                 new_tokens=len(req.out_tokens),
+                                 queue_wait_s=req.queue_wait_s,
+                                 service_ttft_s=req.service_ttft_s,
+                                 admission_attempts=req.admission_attempts)
+            if trace is not None:
+                trace.complete(req.rid, t=req.t_last,
+                               telemetry=eng.telemetry)
 
         while pending or any(queues) or any(active):
             now = time.perf_counter() - t0
             if self.max_wall_s is not None and now > self.max_wall_s:
+                t_ab = time.perf_counter()
                 for i, eng in enumerate(self.engines):
+                    abort = getattr(eng, "abort_request", None)
                     for slot in list(active[i]):
+                        req = active[i][slot]
+                        if trace is not None:
+                            trace.abort(req.rid, "max_wall", t=t_ab,
+                                        telemetry=eng.telemetry)
+                        if abort is not None:
+                            abort(req.rid, "max_wall")
                         eng.release_slot(slot)
                         del active[i][slot]
+                    for req in queues[i]:
+                        if trace is not None:
+                            trace.abort(req.rid, "starved", t=t_ab,
+                                        telemetry=eng.telemetry)
+                        if abort is not None:
+                            abort(req.rid, "starved")
                 break
             # 1. arrivals route to a replica queue immediately.
             while pending and pending[0].arrival_s <= now:
                 req = pending.popleft()
                 req.t_arrival = t0 + req.arrival_s
+                if trace is not None:
+                    trace.enqueue(req.rid, t=req.t_arrival)
                 i = self.route(req, queues)
                 replica_of[req.rid] = i
                 queues[i].append(req)
@@ -138,8 +205,25 @@ class ReplicaRouter:
                             req.prompt, req.max_new_tokens,
                             exclude_groups=used if batched else None)
                         if slot is None:
+                            # Genuine head-of-queue rejection only when
+                            # no batch exclusions could explain it.
+                            if not used:
+                                req.admission_attempts += 1
+                                reason = getattr(
+                                    eng, "last_admit_block",
+                                    None) or "no_slot"
+                                if trace is not None:
+                                    trace.admit_reject(req.rid,
+                                                       reason=reason)
+                                note = getattr(
+                                    eng, "note_admission_reject", None)
+                                if note is not None:
+                                    note(req.rid, reason,
+                                         req.admission_attempts,
+                                         len(queues[i]))
                             break
                         queues[i].popleft()
+                        req.t_admit = time.perf_counter()
                         used.add(eng.group_of(slot))
                         batch.append((req, slot))
                         if not batched:
@@ -173,6 +257,20 @@ class ReplicaRouter:
                         req.out_tokens = [tok]
                         eng.activate_slot(slot, len(req.prompt), tok)
                         eng.serving.note_prefill(len(req.prompt))
+                        if trace is not None:
+                            trace.admit(req.rid, slot, t=req.t_admit,
+                                        replica=label(i))
+                            info_fn = getattr(eng, "last_admit_info",
+                                              None)
+                            info = info_fn(slot) if info_fn else {}
+                            trace.prefill(
+                                req.rid, t_now - (req.t_admit or t_now),
+                                tokens=len(req.prompt),
+                                chunks=info.get("chunks", 1),
+                                cached_tokens=info.get(
+                                    "cached_tokens", 0),
+                                cow_fork=info.get("cow_fork", False))
+                            trace.first_token(req.rid, t=t_now)
                         if finished(req, eng, slot):
                             complete(req, eng)
                             eng.release_slot(slot)
@@ -186,16 +284,21 @@ class ReplicaRouter:
                     emitted, n_new = eng.spec_decode_once(
                         self.temperature)
                     t_now = time.perf_counter()
+                    occ = len(active[i])
                     for slot in list(active[i]):
                         req = active[i][slot]
                         budget = req.max_new_tokens - len(req.out_tokens)
-                        toks = [int(t) for t in
-                                emitted[slot, :int(n_new[slot])]]
+                        n = int(n_new[slot])
+                        toks = [int(t) for t in emitted[slot, :n]]
                         if self.eos_token is not None and \
                                 self.eos_token in toks:
                             toks = toks[:toks.index(self.eos_token) + 1]
                         req.out_tokens.extend(toks[:max(budget, 0)])
                         req.t_last = t_now
+                        if trace is not None:
+                            trace.tick(req.rid, occ, n, t=t_now,
+                                       proposed=eng.spec_k,
+                                       accepted=max(n - 1, 0))
                         if finished(req, eng, slot):
                             complete(req, eng)
                             eng.release_slot(slot)
@@ -203,10 +306,13 @@ class ReplicaRouter:
                 else:
                     sampled, _ = eng.decode_once(self.temperature)
                     t_now = time.perf_counter()
+                    occ = len(active[i])
                     for slot in list(active[i]):
                         req = active[i][slot]
                         req.out_tokens.append(int(sampled[slot]))
                         req.t_last = t_now
+                        if trace is not None:
+                            trace.tick(req.rid, occ, 1, t=t_now)
                         if finished(req, eng, slot):
                             complete(req, eng)
                             eng.release_slot(slot)
@@ -228,7 +334,15 @@ class ReplicaRouter:
                         "the block pool's per-group capacity")
                 for eng in self.engines:
                     eng.telemetry.heartbeat()
+                t_sl = time.perf_counter()
                 time.sleep(self.idle_sleep_s)
+                dt = time.perf_counter() - t_sl
+                for i, eng in enumerate(self.engines):
+                    led = ledger_of(eng)
+                    if led is not None:
+                        led.note(
+                            "admission_blocked" if queues[i] else "idle",
+                            dt)
 
         wall = time.perf_counter() - t0
         per_replica = []
@@ -250,7 +364,10 @@ class ReplicaRouter:
             "routed": list(self.routed),
             "affinity_overrides": self.affinity_hits,
             "affinity_weight": self.affinity_weight,
+            "decisions_recorded": len(self.decisions),
         }
+        if trace is not None:
+            report["trace"] = trace.summary()
         report["requests"] = [
             {"rid": r.rid, "replica": replica_of.get(r.rid),
              "prompt_tokens": len(r.prompt),

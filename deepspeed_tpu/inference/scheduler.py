@@ -36,13 +36,30 @@ class Request:
     slot: Optional[int] = None
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     t_arrival: float = 0.0              # absolute clock
+    t_admit: Optional[float] = None     # slot acquired (queue wait end)
     t_first: Optional[float] = None     # first token produced (TTFT end)
     t_last: Optional[float] = None      # latest token produced
+    admission_attempts: int = 0         # head-of-queue rejections
 
     @property
     def ttft_s(self) -> Optional[float]:
         return None if self.t_first is None \
             else self.t_first - self.t_arrival
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        """Arrival → admission: the router/scheduler backlog share of
+        TTFT (the part more replicas would fix)."""
+        return None if self.t_admit is None \
+            else self.t_admit - self.t_arrival
+
+    @property
+    def service_ttft_s(self) -> Optional[float]:
+        """Admission → first token: the prefill share of TTFT (the part
+        a faster prefill would fix)."""
+        if self.t_admit is None or self.t_first is None:
+            return None
+        return self.t_first - self.t_admit
 
     @property
     def tpot_s(self) -> Optional[float]:
@@ -111,12 +128,22 @@ class ContinuousBatchingScheduler:
     def __init__(self, engine, temperature: float = 0.0,
                  eos_token: Optional[int] = None,
                  idle_sleep_s: float = 0.0005,
-                 max_wall_s: Optional[float] = None):
+                 max_wall_s: Optional[float] = None,
+                 trace=None):
         self.engine = engine
         self.temperature = float(temperature)
         self.eos_token = eos_token
         self.idle_sleep_s = float(idle_sleep_s)
         self.max_wall_s = max_wall_s
+        # Request-scoped span recorder (monitor/request_trace.py): the
+        # router passes a shared one so a request's route decision and
+        # its replica-side spans land in the same record; standalone
+        # serves build their own when telemetry is on. Pure host state —
+        # zero added device syncs either way.
+        if trace is None and getattr(engine.telemetry, "enabled", False):
+            from ..monitor.request_trace import RequestTrace
+            trace = RequestTrace()
+        self.trace = trace
 
     # ------------------------------------------------------------------ #
     def _finished(self, req: Request, slot_len: int) -> bool:
@@ -132,7 +159,40 @@ class ContinuousBatchingScheduler:
         self.engine.complete_request(
             req.rid, req.ttft_s or 0.0, req.tpot_s,
             prompt_tokens=len(req.prompt),
-            new_tokens=len(req.out_tokens))
+            new_tokens=len(req.out_tokens),
+            queue_wait_s=req.queue_wait_s,
+            service_ttft_s=req.service_ttft_s,
+            admission_attempts=req.admission_attempts)
+        if self.trace is not None:
+            self.trace.complete(req.rid, t=req.t_last,
+                                telemetry=self.engine.telemetry)
+
+    def _reject(self, req: Request, queue_len: int) -> None:
+        """Head-of-queue admission rejection: per-request attempt count,
+        aggregator total, first-rejection event, trace mark."""
+        eng = self.engine
+        req.admission_attempts += 1
+        reason = getattr(eng, "last_admit_block", None) or "no_slot"
+        if self.trace is not None:
+            self.trace.admit_reject(req.rid, reason=reason)
+        note = getattr(eng, "note_admission_reject", None)
+        if note is not None:
+            note(req.rid, reason, req.admission_attempts, queue_len)
+
+    def _admit_trace(self, req: Request, slot: int) -> None:
+        if self.trace is None:
+            return
+        eng = self.engine
+        self.trace.admit(req.rid, slot, t=req.t_admit,
+                         replica=getattr(eng, "replica", "") or None)
+        info_fn = getattr(eng, "last_admit_info", None)
+        info = info_fn(slot) if info_fn is not None else {}
+        self.trace.prefill(req.rid, (req.t_first or req.t_admit)
+                           - req.t_admit, tokens=len(req.prompt),
+                           chunks=info.get("chunks", 1),
+                           cached_tokens=info.get("cached_tokens", 0),
+                           cow_fork=info.get("cow_fork", False))
+        self.trace.first_token(req.rid, t=req.t_first)
 
     # ------------------------------------------------------------------ #
     def serve(self, requests: Sequence[Request]) -> Dict[str, Any]:
@@ -140,6 +200,8 @@ class ContinuousBatchingScheduler:
         (the aggregator snapshot + per-request records)."""
         eng = self.engine
         t0 = time.perf_counter()
+        trace = self.trace
+        ledger = getattr(eng.serving, "ledger", None)
         pending = deque(sorted(requests, key=lambda r: r.arrival_s))
         queue: deque = deque()
         active: Dict[int, Request] = {}
@@ -169,14 +231,32 @@ class ContinuousBatchingScheduler:
                 # Abandon the run WITHOUT leaking capacity: mid-flight
                 # slots must come back, or the engine's next serve()
                 # starts with no free slots and spins forever.
+                abort = getattr(eng, "abort_request", None)
+                t_ab = time.perf_counter()
                 for slot in list(active):
+                    req = active[slot]
+                    if trace is not None:
+                        trace.abort(req.rid, "max_wall", t=t_ab,
+                                    telemetry=eng.telemetry)
+                    if abort is not None:
+                        abort(req.rid, "max_wall")
                     _release(slot)
                     del active[slot]
+                for req in queue:
+                    # Enqueued but never admitted: starved, not served —
+                    # counts against SLO availability like any abort.
+                    if trace is not None:
+                        trace.abort(req.rid, "starved", t=t_ab,
+                                    telemetry=eng.telemetry)
+                    if abort is not None:
+                        abort(req.rid, "starved")
                 break
             # 1. open-loop arrivals join the queue on schedule.
             while pending and pending[0].arrival_s <= now:
                 req = pending.popleft()
                 req.t_arrival = t0 + req.arrival_s
+                if trace is not None:
+                    trace.enqueue(req.rid, t=req.t_arrival)
                 queue.append(req)
             # 2. admissions: prefill into free slots. FCFS — when the
             # head of the queue cannot be admitted (no slot, or the
@@ -196,8 +276,15 @@ class ContinuousBatchingScheduler:
                         slot = select(req.prompt, req.max_new_tokens,
                                       exclude_groups=used)
                         if slot is None:
+                            # Only a rejection with NO exclusions is the
+                            # gate refusing the head (with exclusions it
+                            # may just be this batch's one-slot-per-group
+                            # shape).
+                            if not used:
+                                self._reject(req, len(queue))
                             break
                         queue.popleft()
+                        req.t_admit = time.perf_counter()
                         used.add(eng.group_of(slot))
                         batch.append((req, slot))
                     if not batch:
@@ -216,6 +303,7 @@ class ContinuousBatchingScheduler:
                         req.out_tokens = [tok]
                         eng.activate_slot(slot, len(req.prompt), tok)
                         eng.serving.note_prefill(len(req.prompt))
+                        self._admit_trace(req, slot)
                         if self._finished(req, eng.context_len(slot)):
                             self._complete(req)
                             _release(slot)
@@ -226,12 +314,14 @@ class ContinuousBatchingScheduler:
                 if select is not None:
                     slot = select(req.prompt, req.max_new_tokens)
                     if slot is None:
+                        self._reject(req, len(queue))
                         break
                 elif free:
                     slot = free.popleft()
                 else:
                     break
                 queue.popleft()
+                req.t_admit = time.perf_counter()
                 with eng.telemetry.span("prefill", slot=slot,
                                         tokens=len(req.prompt)):
                     tok, _ = eng.prefill(
@@ -242,6 +332,7 @@ class ContinuousBatchingScheduler:
                 req.out_tokens = [tok]
                 eng.activate_slot(slot, len(req.prompt), tok)
                 eng.serving.note_prefill(len(req.prompt))
+                self._admit_trace(req, slot)
                 if self._finished(req, eng.context_len(slot)):
                     self._complete(req)
                     _release(slot)
@@ -252,6 +343,7 @@ class ContinuousBatchingScheduler:
             if active and spec:
                 emitted, n_new = eng.spec_decode_once(self.temperature)
                 t_now = time.perf_counter()
+                occ = len(active)
                 for slot in list(active):
                     req = active[slot]
                     budget = req.max_new_tokens - len(req.out_tokens)
@@ -262,6 +354,10 @@ class ContinuousBatchingScheduler:
                         toks = toks[:toks.index(self.eos_token) + 1]
                     req.out_tokens.extend(toks[:max(budget, 0)])
                     req.t_last = t_now
+                    if trace is not None:
+                        trace.tick(req.rid, occ, n, t=t_now,
+                                   proposed=eng.spec_k,
+                                   accepted=max(n - 1, 0))
                     if self._finished(req, eng.context_len(slot)):
                         self._complete(req)
                         _release(slot)
@@ -269,10 +365,13 @@ class ContinuousBatchingScheduler:
             elif active:
                 sampled, _ = eng.decode_once(self.temperature)
                 t_now = time.perf_counter()
+                occ = len(active)
                 for slot in list(active):
                     req = active[slot]
                     req.out_tokens.append(int(sampled[slot]))
                     req.t_last = t_now
+                    if trace is not None:
+                        trace.tick(req.rid, occ, 1, t=t_now)
                     if self._finished(req, eng.context_len(slot)):
                         self._complete(req)
                         _release(slot)
@@ -284,7 +383,10 @@ class ContinuousBatchingScheduler:
                 eng.telemetry.heartbeat()
                 gap = pending[0].arrival_s - (time.perf_counter() - t0)
                 if gap > 0:
+                    t_sl = time.perf_counter()
                     time.sleep(min(gap, self.idle_sleep_s))
+                    if ledger is not None:
+                        ledger.note("idle", time.perf_counter() - t_sl)
             elif queue:
                 # Queued work but no free slot and nothing decoding:
                 # capacity is held outside this serve (caller-activated
@@ -301,7 +403,11 @@ class ContinuousBatchingScheduler:
                         f"{req.max_new_tokens} new tokens exceeds the "
                         "block pool's per-group capacity")
                 eng.telemetry.heartbeat()
+                t_sl = time.perf_counter()
                 time.sleep(self.idle_sleep_s)
+                if ledger is not None:
+                    ledger.note("admission_blocked",
+                                time.perf_counter() - t_sl)
 
         wall = time.perf_counter() - t0
         # Final drain with a SERVE-WALL-anchored snapshot: a run shorter
@@ -317,6 +423,8 @@ class ContinuousBatchingScheduler:
         report = dict(eng.serving.snapshot(wall_s=wall))
         report["recompiles"] = eng.telemetry.recompile_count
         report["unfinished"] = len(pending) + len(queue) + len(active)
+        if trace is not None:
+            report["trace"] = trace.summary()
         report["requests"] = [
             {"rid": r.rid, "prompt_tokens": len(r.prompt),
              "new_tokens": len(r.out_tokens),

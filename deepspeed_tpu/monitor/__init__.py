@@ -24,7 +24,9 @@ from .memory import (MemoryWatermark, analytic_state_bytes,
 from .peaks import (TPU_PEAK_TFLOPS, ChipPeaks, chip_peak_tflops,
                     chip_peaks)
 from .recompile import RecompileError, RecompileSentinel
+from .request_trace import RequestTrace, validate_timeline
 from .serving import ServingAggregator
+from .serving_slo import (SERVING_BUCKETS, ServingGoodputLedger, SLOTracker)
 from .telemetry import JsonlSink, Telemetry
 from .trace import ProfilerWindow, TraceWriter
 
@@ -33,6 +35,8 @@ __all__ = [
     "RecompileSentinel", "RecompileError", "MemoryWatermark",
     "analytic_state_bytes", "device_memory_stats",
     "GoodputLedger", "GOODPUT_BUCKETS", "ServingAggregator",
+    "ServingGoodputLedger", "SLOTracker", "SERVING_BUCKETS",
+    "RequestTrace", "validate_timeline",
     "HealthMonitor", "EwmaDetector", "HangWatchdog", "TapSpec",
     "leaf_sq_taps", "FlightRecorder",
     "process_identity", "resolve_writer", "shard_path",

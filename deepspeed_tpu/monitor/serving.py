@@ -73,10 +73,23 @@ class ServingAggregator:
         self.attend_bytes_kernel = 0
         self.attend_bytes_onehot = 0
         self.attend_tokens = 0
+        # Admission-rejection accounting (the reservation gate's retries
+        # used to be invisible): total rejected reservations plus the
+        # per-completed-request attempt counts.
+        self.reservations_rejected = 0
+        self._admission_attempts: List[float] = []
+        # Optional overlays (engine-attached): a ServingGoodputLedger
+        # and an SLOTracker (monitor/serving_slo.py) — or, on a merged
+        # aggregator, their already-settled snapshot dicts. When unset
+        # the snapshot omits the sections (skip-never-fail downstream).
+        self.ledger: Optional[Any] = None
+        self.slo: Optional[Any] = None
         self._occupancy: List[float] = []
         self._decode_ms: List[float] = []
         self._ttft_ms: List[float] = []
         self._tpot_ms: List[float] = []
+        self._queue_wait_ms: List[float] = []
+        self._service_ttft_ms: List[float] = []
         self._hbm_per_token: List[float] = []
         self._cache_bytes: List[int] = []
 
@@ -125,13 +138,29 @@ class ServingAggregator:
         self.attend_bytes_onehot += int(bytes_onehot)
         self.attend_tokens += int(tokens)
 
+    def note_reject(self) -> None:
+        """One reservation-gate / slot-pool admission rejection."""
+        self.reservations_rejected += 1
+
     # ---- per completed request ---- #
     def note_request(self, ttft_s: float, tpot_s: Optional[float],
-                     new_tokens: int) -> None:
+                     new_tokens: int,
+                     queue_wait_s: Optional[float] = None,
+                     service_ttft_s: Optional[float] = None,
+                     admission_attempts: Optional[int] = None) -> None:
+        """``queue_wait_s``/``service_ttft_s`` split the end-to-end TTFT
+        at the admission instant (router backlog vs slow prefill —
+        indistinguishable in the pooled ttft figure alone)."""
         self.completed += 1
         self._ttft_ms.append(ttft_s * 1e3)
         if tpot_s is not None:
             self._tpot_ms.append(tpot_s * 1e3)
+        if queue_wait_s is not None:
+            self._queue_wait_ms.append(queue_wait_s * 1e3)
+        if service_ttft_s is not None:
+            self._service_ttft_ms.append(service_ttft_s * 1e3)
+        if admission_attempts is not None:
+            self._admission_attempts.append(float(admission_attempts))
 
     @property
     def occupancy_mean(self) -> float:
@@ -165,6 +194,23 @@ class ServingAggregator:
         }
         if self.label is not None:
             snap["replica"] = self.label
+        if self._queue_wait_ms:
+            snap["queue_wait_ms"] = _pcts(self._queue_wait_ms)
+        if self._service_ttft_ms:
+            snap["service_ttft_ms"] = _pcts(self._service_ttft_ms)
+        if self.reservations_rejected or self._admission_attempts:
+            snap["admission"] = {
+                "reservations_rejected": self.reservations_rejected,
+                "attempts": _pcts(self._admission_attempts),
+            }
+        if self.ledger is not None:
+            snap["ledger"] = self.ledger.snapshot(wall_s=wall) \
+                if hasattr(self.ledger, "snapshot") else self.ledger
+        if self.slo is not None:
+            slo = self.slo.snapshot() if hasattr(self.slo, "snapshot") \
+                else self.slo
+            if slo is not None:
+                snap["slo"] = slo
         if self._hbm_per_token:
             snap["hbm_bytes_per_token"] = _pcts(self._hbm_per_token)
             snap["cache_bytes_p95"] = int(percentile(
@@ -234,8 +280,24 @@ class ServingAggregator:
             out._decode_ms.extend(a._decode_ms)
             out._ttft_ms.extend(a._ttft_ms)
             out._tpot_ms.extend(a._tpot_ms)
+            out._queue_wait_ms.extend(a._queue_wait_ms)
+            out._service_ttft_ms.extend(a._service_ttft_ms)
+            out._admission_attempts.extend(a._admission_attempts)
+            out.reservations_rejected += a.reservations_rejected
             out._hbm_per_token.extend(a._hbm_per_token)
             out._cache_bytes.extend(a._cache_bytes)
+        # Fleet-level SLO/ledger views: pooled outcomes and bucket-wise
+        # sums, stored as settled dicts (a merged aggregator keeps
+        # accumulating nothing).
+        from .serving_slo import ServingGoodputLedger, SLOTracker
+        trackers = [a.slo for a in aggs if isinstance(a.slo, SLOTracker)]
+        if trackers:
+            out.slo = SLOTracker.merged(trackers)
+        led = [a.ledger.snapshot() for a in aggs
+               if a.ledger is not None and hasattr(a.ledger, "snapshot")]
+        led += [a.ledger for a in aggs if isinstance(a.ledger, dict)]
+        if led:
+            out.ledger = ServingGoodputLedger.merged(led)
         return out
 
 

@@ -92,6 +92,22 @@ class TraceWriter:
         with self._lock:
             self._events.append(ev)
 
+    def flow(self, name: str, flow_id: int, phase: str, t_abs: float,
+             tid: int = 0, cat: str = "request") -> None:
+        """Flow-event arrow (ph ``s``/``t``/``f``) linking spans across
+        lanes — Perfetto draws one arrow chain per ``flow_id`` (e.g. a
+        request's route→admit→first-token across replica tracks)."""
+        if self.closed or not self.is_writer:
+            return
+        if phase not in ("s", "t", "f"):
+            raise ValueError(f"flow phase must be s/t/f, got {phase!r}")
+        ev = {"name": name, "cat": cat, "ph": phase, "id": int(flow_id),
+              "pid": self._pid, "tid": tid, "ts": self._ts_us(t_abs)}
+        if phase == "f":
+            ev["bp"] = "e"  # bind to the enclosing slice, not the next one
+        with self._lock:
+            self._events.append(ev)
+
     @contextmanager
     def span(self, name: str, **args):
         t0 = time.perf_counter()

@@ -298,6 +298,51 @@ class TelemetryConfig:
                 f"got {self.per_host_shards!r}")
 
 
+class InferenceSloConfig:
+    """The ``inference.slo`` block (monitor/serving_slo.py): TTFT/TPOT
+    targets, availability target, and the trailing attainment window.
+    Both latency targets unset (0) leaves the tracker off — snapshots
+    then omit the ``slo`` section entirely."""
+
+    def __init__(self, d: Optional[Dict[str, Any]] = None):
+        d = d or {}
+        get = config_utils.get_scalar_param
+        self.ttft_ms = get(d, C.INFERENCE_SLO_TTFT_MS,
+                           C.INFERENCE_SLO_TTFT_MS_DEFAULT)
+        self.tpot_ms = get(d, C.INFERENCE_SLO_TPOT_MS,
+                           C.INFERENCE_SLO_TPOT_MS_DEFAULT)
+        self.availability = get(d, C.INFERENCE_SLO_AVAILABILITY,
+                                C.INFERENCE_SLO_AVAILABILITY_DEFAULT)
+        self.window_s = get(d, C.INFERENCE_SLO_WINDOW_S,
+                            C.INFERENCE_SLO_WINDOW_S_DEFAULT)
+        self._validate()
+
+    @property
+    def enabled(self) -> bool:
+        return self.ttft_ms > 0 or self.tpot_ms > 0
+
+    def _validate(self) -> None:
+        blk = f"{C.INFERENCE}.{C.INFERENCE_SLO}"
+        for name, v in ((C.INFERENCE_SLO_TTFT_MS, self.ttft_ms),
+                        (C.INFERENCE_SLO_TPOT_MS, self.tpot_ms)):
+            if not isinstance(v, (int, float)) or isinstance(v, bool) \
+                    or v < 0:
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a non-negative number "
+                    f"(0 = target unset), got {v!r}")
+        if not isinstance(self.availability, (int, float)) \
+                or isinstance(self.availability, bool) \
+                or not (0.0 < self.availability < 1.0):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.INFERENCE_SLO_AVAILABILITY} must be a number "
+                f"in (0, 1), got {self.availability!r}")
+        if not isinstance(self.window_s, (int, float)) \
+                or isinstance(self.window_s, bool) or self.window_s <= 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.INFERENCE_SLO_WINDOW_S} must be a positive "
+                f"number of seconds, got {self.window_s!r}")
+
+
 class InferenceConfig:
     """The ``inference`` block (inference/ serving subsystem).
 
@@ -332,6 +377,12 @@ class InferenceConfig:
                            C.INFERENCE_REPLICA_DEFAULT)
         self.paged_kernel = get(d, C.INFERENCE_PAGED_KERNEL,
                                 C.INFERENCE_PAGED_KERNEL_DEFAULT)
+        slo_d = d.get(C.INFERENCE_SLO)
+        if slo_d is not None and not isinstance(slo_d, dict):
+            raise DeepSpeedConfigError(
+                f"{C.INFERENCE}.{C.INFERENCE_SLO} must be a dict block, "
+                f"got {slo_d!r}")
+        self.slo = InferenceSloConfig(slo_d)
         self._validate()
 
     def _validate(self) -> None:

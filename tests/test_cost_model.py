@@ -399,7 +399,8 @@ class TestBenchGate:
                     "accept_rate": None, "moe_drop": None,
                     "dcn_bytes": None, "ckpt_share": None,
                     "ckpt_every": None, "attend_ratio": None,
-                    "z3_dcn_bytes": None, "z3_dcn_param": None}
+                    "z3_dcn_bytes": None, "z3_dcn_param": None,
+                    "slo_attainment": None, "ledger_consistent": None}
         # driver round file wrapping a bench record
         m = bg.extract_metrics({"n": 6, "parsed": {"mfu": 0.55}})
         assert m == {"mfu": 0.55, "goodput": None, **none_srv}

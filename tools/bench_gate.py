@@ -45,6 +45,13 @@ rounds gate ``serving.attend_work_ratio`` (the analytic one-hot-over-
 kernel attend HBM ratio the engine prices per iteration; regression =
 a relative DROP beyond ``--attend-drop``, default 10% — the structural
 win shrank); pre-kernel rounds skip, never fail. A TELEMETRY.json carrying a ``health``
+SLO rounds (a serving record carrying the ``slo`` tracker snapshot, or
+a TELEMETRY.json ``serving_slo`` section) gate the SLO attainment
+fraction on an ABSOLUTE drop beyond ``--slo-drop`` (default 0.05), and
+validate the serving goodput ledger's ``consistent`` verdict on the
+NEW side alone — double-attribution (a wall second charged to two
+buckets) is a defect to refuse, not a regression to diff; pre-SLO
+rounds skip both, never fail. A TELEMETRY.json carrying a ``health``
 section is additionally validated on the NEW side alone: UNSKIPPED
 non-finite anomalies (overflow-skipped steps are routine fp16
 loss-scale mechanics and do not gate), watchdog fires, or a ``truncated`` stream (a segment that
@@ -178,6 +185,35 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
         # field -> skipped, never failed.
         if srv.get("attend_work_ratio") is not None:
             attend_ratio = float(srv["attend_work_ratio"])
+    # Serving SLO shape: SERVE_BENCH.json's serving record carries the
+    # pooled SLO tracker snapshot ("slo") and the serving goodput
+    # ledger ("ledger"); a TELEMETRY.json carries the same figures in
+    # its "serving_slo" section. Gated: SLO attainment (ABSOLUTE drop)
+    # plus the ledger `consistent` verdict validated on the NEW side
+    # alone (double-attribution is a defect, not a diff). Pre-SLO
+    # rounds carry neither -> skipped, never failed.
+    slo_attainment: Optional[float] = None
+    ledger_consistent: Optional[bool] = None
+    if isinstance(srv, dict):
+        sslo = srv.get("slo")
+        if isinstance(sslo, dict) and sslo.get("attainment") is not None:
+            slo_attainment = float(sslo["attainment"])
+        sled = srv.get("ledger")
+        if isinstance(sled, dict) and "consistent" in sled:
+            ledger_consistent = bool(sled["consistent"])
+    ssec = doc.get("serving_slo")
+    if isinstance(ssec, dict) and ssec.get("available", True):
+        tslo = ssec.get("slo")
+        if slo_attainment is None and isinstance(tslo, dict):
+            atts = [b["attainment"] for b in
+                    (tslo.get("burn") or {}).values()
+                    if b.get("attainment") is not None]
+            if atts:
+                slo_attainment = min(float(a) for a in atts)
+        tled = ssec.get("ledger")
+        if ledger_consistent is None and isinstance(tled, dict) \
+                and "consistent" in tled:
+            ledger_consistent = bool(tled["consistent"])
     # MoE shape: a TELEMETRY.json `moe` section or an MOE_BENCH.json
     # record — the gated figure is the drop-fraction p95 (regression =
     # an ABSOLUTE rise: dropped tokens are silently-skipped compute).
@@ -248,6 +284,8 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "z3_dcn_bytes": z3_dcn_bytes, "z3_dcn_param": z3_dcn_param,
             "hbm_per_token": hbm_per_token, "accept_rate": accept_rate,
             "attend_ratio": attend_ratio,
+            "slo_attainment": slo_attainment,
+            "ledger_consistent": ledger_consistent,
             "moe_drop": moe_drop, "dcn_bytes": dcn_bytes,
             "ckpt_share": ckpt_share, "ckpt_every": ckpt_every}
 
@@ -276,7 +314,7 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
          hbm_rise: float = 0.15, accept_floor: float = 0.05,
          moe_drop_rise: float = 0.05, dcn_rise: float = 0.10,
          ckpt_share_max: float = 0.05, tile_drop: float = 0.10,
-         attend_drop: float = 0.10) -> int:
+         attend_drop: float = 0.10, slo_drop: float = 0.05) -> int:
     old = extract_metrics(_load(old_path))
     new = extract_metrics(_load(new_path))
     name_old, name_new = os.path.basename(old_path), \
@@ -439,6 +477,43 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
         # Pre-spec-decode rounds skip, never fail.
         print(f"spec-decode acceptance: skipped (no spec record in "
               f"{name_new})")
+
+    if old["slo_attainment"] is not None and \
+            new["slo_attainment"] is not None:
+        compared += 1
+        floor = old["slo_attainment"] - slo_drop
+        verdict = "OK" if new["slo_attainment"] >= floor else "REGRESSION"
+        print(f"serving slo attainment: {name_old}="
+              f"{old['slo_attainment']:.4f} -> "
+              f"{name_new}={new['slo_attainment']:.4f} "
+              f"(floor {floor:.4f}, -{slo_drop:.2f} abs): {verdict}")
+        if verdict != "OK":
+            rc = 1
+    else:
+        # Pre-SLO rounds (no inference.slo target configured, or
+        # recorded before the SLO tracker existed) skip, never fail.
+        missing = [n for n, m in ((name_old, old), (name_new, new))
+                   if m["slo_attainment"] is None]
+        print(f"serving slo attainment: skipped (no slo record in "
+              f"{', '.join(missing)} — pre-SLO round)")
+
+    # Serving-ledger consistency: NEW side only (a defect to refuse,
+    # not a regression to diff) — `consistent: false` means some wall
+    # second was attributed to two buckets at once, and every share the
+    # ledger reports is suspect. Pre-ledger rounds skip, never fail.
+    if new["ledger_consistent"] is not None:
+        compared += 1
+        verdict = "OK" if new["ledger_consistent"] else "FAIL"
+        print(f"serving ledger consistency: {name_new}: "
+              + ("buckets sum to wall (no double-attribution)"
+                 if new["ledger_consistent"] else
+                 "double-attribution detected (buckets overlap)")
+              + f": {verdict}")
+        if not new["ledger_consistent"]:
+            rc = 1
+    else:
+        print(f"serving ledger consistency: skipped (no ledger record "
+              f"in {name_new} — pre-ledger round)")
 
     if old["zero3_overlap"] is not None and \
             new["zero3_overlap"] is not None:
@@ -622,6 +697,9 @@ def main(argv=None) -> int:
     ap.add_argument("--ckpt-share-max", type=float, default=0.05,
                     help="ABSOLUTE ceiling on the checkpoint-exposed "
                          "goodput share, new side (default 0.05)")
+    ap.add_argument("--slo-drop", type=float, default=0.05,
+                    help="max tolerated ABSOLUTE serving SLO-attainment "
+                         "drop (default 0.05)")
     args = ap.parse_args(argv)
     if len(args.files) == 2:
         old_path, new_path = args.files
@@ -641,7 +719,8 @@ def main(argv=None) -> int:
                     args.hbm_rise, args.accept_floor, args.moe_drop_rise,
                     args.dcn_rise, args.ckpt_share_max,
                     tile_drop=args.tile_drop,
-                    attend_drop=args.attend_drop)
+                    attend_drop=args.attend_drop,
+                    slo_drop=args.slo_drop)
     except (OSError, json.JSONDecodeError) as e:
         print(f"bench_gate: cannot read inputs: {e}")
         return 2

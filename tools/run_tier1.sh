@@ -9,6 +9,13 @@
 #   --health / HEALTH_GATE=1 : run the dp=8 health self-check
 #       (tools/health_check.py): induced-NaN provenance, flight
 #       recorder + final marker, zero added hot-path device syncs.
+#   --serve-slo / SERVE_SLO_GATE=1 : run the dp=8 serving-observability
+#       self-check (tools/serve_slo_check.py): reduced shared-prefix
+#       saturation stream through two router replicas — contiguous
+#       request-span timelines re-validated from the JSONL, consistent
+#       per-replica goodput ledgers, a parseable serving_slo report
+#       section, and zero added hot-path device syncs vs a
+#       telemetry-disabled twin.
 #   --resilience / RESILIENCE_GATE=1 : run the crash/kill/resume
 #       harness (tools/crashkill.py run --quick: real SIGTERM/SIGKILL
 #       at random steps incl. mid-write, loadable-latest probe after
@@ -23,6 +30,7 @@ for arg in "$@"; do
     --bench-gate) BENCH_GATE=1 ;;
     --lint) LINT_GATE=1 ;;
     --health) HEALTH_GATE=1 ;;
+    --serve-slo) SERVE_SLO_GATE=1 ;;
     --resilience) RESILIENCE_GATE=1 ;;
   esac
 done
@@ -34,6 +42,9 @@ if [ "${LINT_GATE:-0}" = "1" ]; then
 fi
 if [ "${HEALTH_GATE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python tools/health_check.py || rc=1
+fi
+if [ "${SERVE_SLO_GATE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python tools/serve_slo_check.py || rc=1
 fi
 if [ "${RESILIENCE_GATE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python tools/crashkill.py run --quick || rc=1

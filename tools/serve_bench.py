@@ -89,6 +89,10 @@ def _serve(args, cfg, params, *, replicas, block_size, spec_k, label,
     inf_cfg_extra = {}
     if paged_kernel is not None:
         inf_cfg_extra["paged_kernel"] = paged_kernel
+    if args.slo_ttft_ms or args.slo_tpot_ms:
+        inf_cfg_extra["slo"] = {"ttft_ms": args.slo_ttft_ms,
+                                "tpot_ms": args.slo_tpot_ms,
+                                "availability": args.slo_availability}
     tel_dir = tempfile.mkdtemp(prefix=f"serve_bench_{label}_")
     engines = []
     for i in range(replicas):
@@ -211,6 +215,13 @@ def main():
     ap.add_argument("--warmup", type=int, default=4,
                     help="warmup tokens per throwaway request before "
                          "the measured stream (0 = cold, PR-7 style)")
+    ap.add_argument("--slo-ttft-ms", type=float, default=10000.0,
+                    help="TTFT SLO target (ms); CPU-mesh-loose default. "
+                         "0 disables the TTFT criterion")
+    ap.add_argument("--slo-tpot-ms", type=float, default=1000.0,
+                    help="TPOT SLO target (ms); 0 disables")
+    ap.add_argument("--slo-availability", type=float, default=0.99,
+                    help="target fraction of requests inside SLO")
     ap.add_argument("--no-baseline", action="store_true",
                     help="skip the slot-major single-replica baseline")
     ap.add_argument("--no-ablation", action="store_true",
@@ -270,11 +281,15 @@ def main():
                    "max_new_tokens": args.max_new,
                    "prompt_len": list(args.prompt_len),
                    "arrival_rate_rps": args.rate,
-                   "temperature": args.temperature},
+                   "temperature": args.temperature,
+                   "slo": {"ttft_ms": args.slo_ttft_ms,
+                           "tpot_ms": args.slo_tpot_ms,
+                           "availability": args.slo_availability}},
         "serving": serving,
         "replicas": report.get("replicas"),
         "router": report.get("router"),
         "telemetry_report_serving": telemetry.get("serving"),
+        "telemetry_report_serving_slo": telemetry.get("serving_slo"),
         "honest_note": (
             "virtual 8-device CPU mesh: absolute tokens/s and latency "
             "measure XLA's CPU backend, not a TPU, and emulated "
@@ -320,6 +335,12 @@ def main():
           f"prefix hit={s.get('prefix', {}).get('hit_rate', 'n/a')}, "
           f"accept={s.get('spec', {}).get('acceptance_rate', 'n/a')}, "
           f"recompiles={s['recompiles']}, completed={s['completed']}")
+    if isinstance(s.get("slo"), dict):
+        led = s.get("ledger") or {}
+        print(f"[serve_bench] slo: attainment={s['slo'].get('attainment')}"
+              f", burn={s['slo'].get('burn_rate')}, ledger accounted="
+              f"{led.get('accounted_fraction', 'n/a')} "
+              f"(consistent={led.get('consistent', 'n/a')})")
     if record.get("vs_slot_major"):
         print(f"[serve_bench] vs slot-major baseline: "
               f"{record['vs_slot_major']}")

@@ -33,6 +33,15 @@ so benches and CI can compare runs:
   over decode iterations, TTFT/TPOT p50/p95 from ``request_complete``
   events, tokens/s and decode-step percentiles from the last report's
   aggregator snapshot.
+- ``serving_slo``: request-scoped observability for serving streams —
+  per-replica serving goodput ledger (prefill / decode_useful /
+  spec_wasted / admission_blocked / idle buckets summing to the serve
+  wall, with a double-attribution ``consistent`` verdict), SLO
+  attainment + burn-rate verdicts per replica (``slo: null`` with a
+  reason when no request completed or no target is configured — never
+  a crash), and the slowest-TTFT request exemplars with their full
+  span timelines from the ``request_trace`` events, audited for
+  contiguity (spans must tile [0, total_ms] with no gaps/overlaps).
 - ``moe``: present when the run carried MoE metrics (the engine's
   ``moe`` config block): drop-fraction p50/p95/last, expert-load
   imbalance (max/mean routed counts — 1.0 is balanced), last aux loss,
@@ -463,12 +472,31 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "prefill_tokens": serve_snap.get("prefill_tokens"),
             "decode_tokens": serve_snap.get("decode_tokens"),
         })
-        # Paged-cache / spec-decode / attend-work sections of the
-        # aggregator snapshot pass through when present (pre-paging
-        # streams carry none; ``attend`` is the analytic kernel-vs-
-        # one-hot pricing, projection-labeled at the source).
+        # Queue-wait vs service-TTFT split, recomputed from the
+        # request_complete events (ground truth): queue_wait is router/
+        # scheduler hold time before admission, service_ttft is
+        # admission→first-token — they sum to ttft exactly, so a TTFT
+        # regression is attributable to queuing vs prefill at a glance.
+        qws = sorted(float(e["queue_wait_ms"]) for e in completions
+                     if "queue_wait_ms" in e)
+        svc = sorted(float(e["service_ttft_ms"]) for e in completions
+                     if "service_ttft_ms" in e)
+        if qws:
+            serving["queue_wait_ms"] = {
+                "p50": round(_percentile(qws, 50), 3),
+                "p95": round(_percentile(qws, 95), 3),
+                "n": len(qws)}
+        if svc:
+            serving["service_ttft_ms"] = {
+                "p50": round(_percentile(svc, 50), 3),
+                "p95": round(_percentile(svc, 95), 3),
+                "n": len(svc)}
+        # Paged-cache / spec-decode / attend-work / admission sections
+        # of the aggregator snapshot pass through when present
+        # (pre-paging streams carry none; ``attend`` is the analytic
+        # kernel-vs-one-hot pricing, projection-labeled at the source).
         for sec in ("hbm_bytes_per_token", "prefix", "spec", "replica",
-                    "attend", "attend_work_ratio"):
+                    "attend", "attend_work_ratio", "admission"):
             if serve_snap.get(sec) is not None:
                 serving[sec] = serve_snap[sec]
         # Multi-replica streams: request_complete events carry replica
@@ -498,6 +526,97 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
                                 "n": len(tp)},
                 }
             serving["replicas"] = per_rep
+
+    # Serving SLO / goodput-ledger section — everything re-validates
+    # from the JSONL alone:
+    # - per-replica wall-time ledger (prefill/decode_useful/spec_wasted/
+    #   admission_blocked/idle buckets summing to the serve wall;
+    #   `consistent` false means double-attribution),
+    # - SLO attainment + burn rate per replica (burn > 1 = the error
+    #   budget is being spent faster than the window allows),
+    # - worst-TTFT request exemplars with their FULL span timelines
+    #   from the `request_trace` events, plus a contiguity audit over
+    #   every recorded timeline (gaps/overlaps = instrumentation bugs).
+    # Zero completed requests is a reported condition (`slo: null` with
+    # the reason), never a crash — a saturated/aborted stream still gets
+    # its ledger and traces summarized.
+    traces = [e for e in events if e.get("event") == "request_trace"]
+    led_by_rep: Dict[str, Any] = {}
+    slo_by_rep: Dict[str, Any] = {}
+    for rep in reports:
+        s = rep.get("serving")
+        if not isinstance(s, dict):
+            continue
+        lab = str(s.get("replica") or "default")
+        if isinstance(s.get("ledger"), dict):
+            led_by_rep[lab] = s["ledger"]
+        if isinstance(s.get("slo"), dict):
+            slo_by_rep[lab] = s["slo"]
+    serving_slo: Dict[str, Any] = {
+        "available": bool(is_serving
+                          and (led_by_rep or slo_by_rep or traces))}
+    if serving_slo["available"]:
+        if led_by_rep:
+            serving_slo["ledger"] = {
+                "replicas": led_by_rep,
+                "consistent": all(bool(l.get("consistent"))
+                                  for l in led_by_rep.values()),
+            }
+        if not completions:
+            serving_slo["slo"] = None
+            serving_slo["slo_unavailable_reason"] = \
+                "no completed requests in this segment"
+        elif slo_by_rep:
+            burn: Dict[str, Any] = {}
+            for lab, s in slo_by_rep.items():
+                br = s.get("burn_rate")
+                burn[lab] = {
+                    "attainment": s.get("attainment"),
+                    "burn_rate": br,
+                    "verdict": ("no_target" if br is None
+                                else "burning" if br > 1.0 else "ok"),
+                }
+            serving_slo["slo"] = {"replicas": slo_by_rep, "burn": burn}
+        else:
+            serving_slo["slo"] = None
+            serving_slo["slo_unavailable_reason"] = \
+                "no slo targets configured (inference.slo unset)"
+        if traces:
+            def _tl_errors(tl: Dict[str, Any]) -> int:
+                """Gap/overlap count: spans must tile [0, total_ms]
+                exactly (shared endpoints by construction)."""
+                spans = tl.get("spans") or []
+                errs = 0 if spans else 1
+                cur = 0.0
+                for sp in spans:
+                    if abs(float(sp.get("t_ms", 0.0)) - cur) > 1e-6:
+                        errs += 1
+                    cur = float(sp.get("t_ms", 0.0)) + \
+                        float(sp.get("dur_ms", 0.0))
+                if spans and abs(cur - float(tl.get("total_ms", 0.0))) \
+                        > 1e-6:
+                    errs += 1
+                return errs
+
+            keep = ("rid", "outcome", "replica", "spans", "total_ms",
+                    "ttft_ms", "queue_wait_ms", "service_ttft_ms",
+                    "admission_attempts", "new_tokens", "route",
+                    "abort_reason")
+            done = sorted(
+                (e for e in traces if e.get("ttft_ms") is not None),
+                key=lambda e: -float(e["ttft_ms"]))
+            serving_slo["traces"] = {
+                "recorded": len(traces),
+                "completed": sum(1 for e in traces
+                                 if e.get("outcome") == "complete"),
+                "aborted": sum(1 for e in traces
+                               if e.get("outcome") == "abort"),
+                "contiguity_violations": sum(
+                    1 for e in traces if _tl_errors(e)),
+                "worst_ttft": [
+                    {k: e[k] for k in keep if k in e}
+                    for e in done[:3]],
+            }
 
     # Truncation: a marker-capable segment without the terminal `final`
     # record died mid-run — its partial-window stats must not read as a
@@ -626,6 +745,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "roofline": roofline,
         "goodput": goodput,
         "serving": serving,
+        "serving_slo": serving_slo,
         "moe": moe,
         "health": health,
         "truncated": truncated,
@@ -673,6 +793,10 @@ def main(argv=None) -> int:
           + (f", attend x{srv['attend_work_ratio']} "
              f"({srv['attend']['mode']}, projected)"
              if srv.get("attend_work_ratio") is not None else "")
+          + (", slo=" + ",".join(
+              f"{lab}:{b['verdict']}" for lab, b in
+              summary["serving_slo"]["slo"]["burn"].items())
+             if summary["serving_slo"].get("slo") else "")
           + health_bits
           + (" — TRUNCATED segment (no final drain marker): stats "
              "cover a partial run" if summary["truncated"] else ""))
