@@ -199,6 +199,27 @@ TELEMETRY_PROFILE_NUM_STEPS = "profile_num_steps"
 TELEMETRY_PROFILE_NUM_STEPS_DEFAULT = 1
 TELEMETRY_PROFILE_DIR = "profile_dir"
 TELEMETRY_PROFILE_DIR_DEFAULT = ""
+# --- telemetry.profile: trace capture + ingestion + reconciliation -----
+# The nested block form (the flat profile_* keys above stay as aliases).
+# start_step >= 0 arms a jax.profiler window of window_steps hot steps;
+# after the window closes, the capture is ingested
+# (monitor/profile_ingest.py) into the per-step wall decomposition,
+# reconciled against the cost model's floors (monitor/reconcile.py), and
+# drained into the JSONL as the ``profile`` report section. Components
+# measuring more than divergence_threshold x their analytic floor (or,
+# for the zero-floor host bucket, more than host_frac of the step wall)
+# fire ``reconcile_divergence`` events.
+TELEMETRY_PROFILE = "profile"
+TELEMETRY_PROFILE_BLOCK_START = "start_step"
+TELEMETRY_PROFILE_BLOCK_START_DEFAULT = -1
+TELEMETRY_PROFILE_BLOCK_STEPS = "window_steps"
+TELEMETRY_PROFILE_BLOCK_STEPS_DEFAULT = 2
+TELEMETRY_PROFILE_BLOCK_DIR = "out_dir"
+TELEMETRY_PROFILE_BLOCK_DIR_DEFAULT = ""
+TELEMETRY_PROFILE_THRESHOLD = "divergence_threshold"
+TELEMETRY_PROFILE_THRESHOLD_DEFAULT = 3.0
+TELEMETRY_PROFILE_HOST_FRAC = "host_frac"
+TELEMETRY_PROFILE_HOST_FRAC_DEFAULT = 0.10
 # Roofline cost model: at the FIRST report boundary, AOT-relower every
 # compiled step path from its recorded abstract signature, pull XLA's
 # cost_analysis() (flops + bytes accessed), fuse it with the jaxpr-walk

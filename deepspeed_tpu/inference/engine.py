@@ -789,6 +789,7 @@ class InferenceEngine:
         (and the [S, V] logits when asked — tests only; the extra fetch
         is not part of the serving loop)."""
         t0 = time.perf_counter()
+        self.telemetry.profiler_tick(self.iterations)
         n_active = self.active_slots
         if self.paged:
             for s in np.flatnonzero(self.active):
@@ -855,6 +856,7 @@ class InferenceEngine:
                 "decode_once for temperature > 0 — the scheduler falls "
                 "back automatically")
         t0 = time.perf_counter()
+        self.telemetry.profiler_tick(self.iterations)
         k = self.spec_k
         n_active = self.active_slots
         toks = np.zeros((self.max_slots, k + 1), np.int32)
@@ -948,6 +950,18 @@ class InferenceEngine:
 
     def _report_extra(self) -> Dict[str, Any]:
         return {"serving": self.serving.snapshot()}
+
+    def profile_window(self, steps: int,
+                       start_step: Optional[int] = None) -> Optional[str]:
+        """Arm a ``jax.profiler`` capture over ``steps`` decode
+        iterations (default: starting at the next iteration). The trace
+        is ingested and reconciled at the next telemetry drain
+        (``telemetry.profile`` block); with telemetry off this is a
+        no-op returning None. Zero device syncs are added when no window
+        is armed — the PR-4 fence contract."""
+        return self.telemetry.arm_profile_window(
+            int(steps), start_step=self.iterations + 1
+            if start_step is None else int(start_step))
 
     def complete_request(self, rid: Any, ttft_s: float,
                          tpot_s: Optional[float], prompt_tokens: int,

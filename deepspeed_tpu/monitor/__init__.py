@@ -8,8 +8,11 @@ device-memory watermarks checked against the analytic ZeRO-partitioned
 model-state footprint, a roofline cost model fusing XLA's compiled cost
 analysis with the jaxpr-walk flops profiler and the interconnect wire
 model (per-path compute/HBM/interconnect-bound verdicts + per-step MFU),
-and a goodput ledger attributing every wall-clock second between report
-boundaries. See docs/tutorials/telemetry.md.
+a goodput ledger attributing every wall-clock second between report
+boundaries, and the measured half of the roofline story: jax.profiler
+trace ingestion into a bucketed per-step wall decomposition
+(profile_ingest) reconciled against the analytic floors (reconcile).
+See docs/tutorials/telemetry.md.
 """
 from .cost_model import (BOUND_COMPUTE, BOUND_HBM, BOUND_INTERCONNECT,
                          build_cost_model, mfu, roofline)
@@ -23,7 +26,10 @@ from .memory import (MemoryWatermark, analytic_state_bytes,
                      device_memory_stats)
 from .peaks import (TPU_PEAK_TFLOPS, ChipPeaks, chip_peak_tflops,
                     chip_peaks)
+from .profile_ingest import (ingest, ingest_from_telemetry,
+                             parse_trace_events)
 from .recompile import RecompileError, RecompileSentinel
+from .reconcile import reconcile
 from .request_trace import RequestTrace, validate_timeline
 from .serving import ServingAggregator
 from .serving_slo import (SERVING_BUCKETS, ServingGoodputLedger, SLOTracker)
@@ -41,6 +47,7 @@ __all__ = [
     "leaf_sq_taps", "FlightRecorder",
     "process_identity", "resolve_writer", "shard_path",
     "build_cost_model", "roofline", "mfu",
+    "ingest", "ingest_from_telemetry", "parse_trace_events", "reconcile",
     "BOUND_COMPUTE", "BOUND_HBM", "BOUND_INTERCONNECT",
     "ChipPeaks", "chip_peaks", "chip_peak_tflops", "TPU_PEAK_TFLOPS",
 ]

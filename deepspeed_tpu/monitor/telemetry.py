@@ -255,11 +255,14 @@ class Telemetry:
                                              if self.watchdog else 0)
                 fl.install(close_cb=self.close)
                 self.meta.setdefault("flight_path", fpath)
+        self._profile_out = cfg.profile_dir or os.path.join(
+            cfg.output_path or "./runs", "jax_trace")
+        self._profile_done: List[Dict[str, Any]] = []
         if int(cfg.profile_start_step) >= 0:
-            out = cfg.profile_dir or os.path.join(
-                cfg.output_path or "./runs", "jax_trace")
             self.profiler = ProfilerWindow(cfg.profile_start_step,
-                                           cfg.profile_num_steps, out)
+                                           cfg.profile_num_steps,
+                                           self._profile_out,
+                                           on_event=self._profiler_event)
         self._meta_written = False
         atexit.register(self.close)
 
@@ -302,6 +305,74 @@ class Telemetry:
     def profiler_tick(self, step: int) -> None:
         if self.profiler is not None:
             self.profiler.tick(step)
+
+    def _profiler_event(self, kind: str, payload: Dict[str, Any]) -> None:
+        """ProfilerWindow outcome callback: every start/stop lands in the
+        JSONL as a structured ``profile_window`` event (host IO only —
+        no device access); a successful stop queues the capture for
+        ingestion at the next report boundary."""
+        self.event(kind, payload)
+        if payload.get("phase") == "stop" and payload.get("ok"):
+            self._profile_done.append(dict(payload))
+
+    def arm_profile_window(self, num_steps: int,
+                           start_step: Optional[int] = None
+                           ) -> Optional[str]:
+        """Arm a ``jax.profiler`` capture window over ``num_steps`` hot
+        steps starting at ``start_step`` (default: the next step).
+        Returns the capture dir, or None when refused (telemetry off, or
+        a previously armed window hasn't finished — windows never
+        clobber each other)."""
+        if not self.enabled:
+            return None
+        p = self.profiler
+        if p is not None and not p.failed and \
+                (p._active or self.step_provider() < p.stop_step):
+            logger.warning("telemetry: profile window already armed for "
+                           f"steps [{p.start_step}, {p.stop_step}); "
+                           "refusing to replace it")
+            return None
+        start = int(self.step_provider() + 1 if start_step is None
+                    else start_step)
+        self.profiler = ProfilerWindow(start, int(num_steps),
+                                       self._profile_out,
+                                       on_event=self._profiler_event)
+        return self.profiler.capture_dir
+
+    def _drain_profiles(self) -> None:
+        """Report-boundary ingestion of completed capture windows: parse
+        the trace, decompose the step wall into buckets, reconcile
+        against the cost model when one is armed, and write one
+        ``profile`` event (+ any ``reconcile_divergence`` events) per
+        window. Pure host-side parsing — no device access."""
+        done, self._profile_done = self._profile_done, []
+        for win in done:
+            from .profile_ingest import ingest
+            n_steps = max(1, int(win.get("stop_step", 1))
+                          - int(win.get("start_step", 0)))
+            try:
+                decomp = ingest(win["path"], n_steps=n_steps)
+            except Exception as e:
+                self.event("profile", {
+                    "window": win,
+                    "error": f"ingest failed ({type(e).__name__}: {e})"})
+                continue
+            payload: Dict[str, Any] = {"window": win,
+                                       "decomposition": decomp}
+            if self.cost_model_payload is not None and \
+                    "error" not in decomp:
+                from .reconcile import divergence_events, reconcile
+                pc = getattr(self.cfg, "profile", None)
+                recon = reconcile(
+                    decomp, self.cost_model_payload,
+                    threshold=getattr(pc, "divergence_threshold", 3.0),
+                    host_frac=getattr(pc, "host_frac", 0.10))
+                payload["reconciliation"] = recon
+                self.event("profile", payload)
+                for d in divergence_events(recon):
+                    self.event("reconcile_divergence", d)
+            else:
+                self.event("profile", payload)
 
     def span(self, name: str, **args):
         """Host-span context manager. Feeds the trace writer (when a
@@ -605,6 +676,8 @@ class Telemetry:
                     "look exactly like this")
                 self.event("memory_watermark", wm_event)
         self._write(report)
+        if self._profile_done:
+            self._drain_profiles()
         if self.flight is not None:
             self.flight.note_report(report)
         if self.tracer is not None:
@@ -649,6 +722,10 @@ class Telemetry:
         self._closed = True
         if self.watchdog is not None:
             self.watchdog.stop()
+        # Stop a still-open capture window BEFORE the terminal drain so
+        # its trace is ingested into this run's JSONL, not lost.
+        if self.profiler is not None:
+            self.profiler.stop()
         if self._ring or (self.ledger is not None
                           and self.ledger.has_pending()):
             # Drain buffered steps AND settle any trailing attributed
@@ -657,6 +734,10 @@ class Telemetry:
             self.drain()
         else:
             self._ensure_meta()
+        if self._profile_done:
+            # A capture that completed after the last boundary (or whose
+            # run had no further drain) still lands in the JSONL.
+            self._drain_profiles()
         # Terminal drain marker: its absence is how the report tool
         # recognizes a truncated segment.
         self._write({"kind": "final", "step": int(self.step_provider()),
@@ -672,8 +753,6 @@ class Telemetry:
         # telemetry enabled pins its full device state until exit.
         atexit.unregister(self.close)
         self.step_provider = lambda: -1
-        if self.profiler is not None:
-            self.profiler.stop()
         if self.tracer is not None:
             self.tracer.close()
         if self.sink is not None:

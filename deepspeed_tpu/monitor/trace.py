@@ -155,14 +155,62 @@ class TraceWriter:
 class ProfilerWindow:
     """Capture a ``jax.profiler`` device trace for ``num_steps`` steps
     starting at ``start_step`` — the device-side complement to the host
-    spans. ``tick(step)`` is two int compares on the hot path."""
+    spans. ``tick(step)`` is two int compares on the hot path.
 
-    def __init__(self, start_step: int, num_steps: int, out_dir: str):
+    Each window captures into its own ``step_<start>_<stop>`` suffix of
+    ``out_dir`` so two windows in one run can never silently overwrite
+    each other — a reused range is refused, not clobbered. Outcomes are
+    surfaced as structured ``profile_window`` events through the
+    ``on_event(kind, payload)`` callback (the telemetry JSONL), so
+    downstream ingestion (monitor/profile_ingest.py) can locate the
+    capture — or learn exactly why there isn't one — from the JSONL
+    alone; log lines are a courtesy copy, not the record.
+    """
+
+    # Capture dirs claimed by any window in this process — the
+    # same-out_dir uniqueness assert for satellite windows.
+    _claimed_dirs: set = set()
+
+    def __init__(self, start_step: int, num_steps: int, out_dir: str,
+                 on_event=None):
         self.start_step = int(start_step)
         self.stop_step = int(start_step) + max(1, int(num_steps))
         self.out_dir = out_dir
+        # Step-range suffix: the actual capture destination.
+        self.capture_dir = os.path.join(
+            out_dir, f"step_{self.start_step}_{self.stop_step}")
+        self._on_event = on_event
         self._active = False
         self.failed = False
+
+    def _emit(self, phase: str, ok: bool, reason: Optional[str] = None,
+              **extra) -> None:
+        payload = {"phase": phase, "path": self.capture_dir,
+                   "start_step": self.start_step,
+                   "stop_step": self.stop_step, "ok": bool(ok)}
+        if reason is not None:
+            payload["reason"] = reason
+        payload.update(extra)
+        if self._on_event is not None:
+            try:
+                self._on_event("profile_window", payload)
+            except Exception as e:  # never take down the step loop
+                logger.warning(f"telemetry: profile_window event emit "
+                               f"failed ({type(e).__name__}: {e})")
+
+    def _claim_dir(self) -> None:
+        """Refuse a capture dir another window already used (in-process
+        set) or that already holds a capture on disk (cross-process) —
+        the silent-overwrite hazard."""
+        if self.capture_dir in ProfilerWindow._claimed_dirs:
+            raise RuntimeError(
+                f"duplicate profile capture dir {self.capture_dir!r} "
+                f"(a window for this step range already ran)")
+        if os.path.isdir(self.capture_dir) and os.listdir(self.capture_dir):
+            raise RuntimeError(
+                f"profile capture dir {self.capture_dir!r} is not empty "
+                f"(refusing to overwrite an existing capture)")
+        ProfilerWindow._claimed_dirs.add(self.capture_dir)
 
     def tick(self, step: int) -> None:
         if self.failed:
@@ -174,13 +222,17 @@ class ProfilerWindow:
         if not self._active and self.start_step <= step < self.stop_step:
             try:
                 import jax
-                os.makedirs(self.out_dir, exist_ok=True)
-                jax.profiler.start_trace(self.out_dir)
+                self._claim_dir()
+                os.makedirs(self.capture_dir, exist_ok=True)
+                jax.profiler.start_trace(self.capture_dir)
                 self._active = True
-            except Exception as e:  # pragma: no cover - backend-dependent
+                self._emit("start", ok=True, armed_at_step=int(step))
+            except Exception as e:
                 self.failed = True
+                reason = f"{type(e).__name__}: {e}"
+                self._emit("start", ok=False, reason=reason)
                 logger.warning(f"telemetry: jax.profiler trace failed to "
-                               f"start ({type(e).__name__}: {e})")
+                               f"start ({reason})")
         elif self._active and step >= self.stop_step:
             self.stop()
 
@@ -191,9 +243,12 @@ class ProfilerWindow:
         try:
             import jax
             jax.profiler.stop_trace()
+            self._emit("stop", ok=True)
             logger.info(f"telemetry: jax.profiler trace written to "
-                        f"{self.out_dir}")
-        except Exception as e:  # pragma: no cover - backend-dependent
+                        f"{self.capture_dir}")
+        except Exception as e:
             self.failed = True
+            reason = f"{type(e).__name__}: {e}"
+            self._emit("stop", ok=False, reason=reason)
             logger.warning(f"telemetry: jax.profiler trace failed to stop "
-                           f"({type(e).__name__}: {e})")
+                           f"({reason})")

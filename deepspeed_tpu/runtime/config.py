@@ -215,6 +215,61 @@ class CheckpointConfig:
                 f"positive number, got {self.writer_timeout_s!r}")
 
 
+class TelemetryProfileConfig:
+    """The ``telemetry.profile`` block (monitor/profile_ingest.py +
+    reconcile.py): the jax.profiler capture window, trace ingestion, and
+    measured-vs-floor reconciliation thresholds. The legacy flat
+    ``telemetry.profile_start_step``/``profile_num_steps``/``profile_dir``
+    keys remain as aliases; an explicit nested block wins."""
+
+    def __init__(self, d: Optional[Dict[str, Any]] = None,
+                 legacy_start: int = C.TELEMETRY_PROFILE_START_STEP_DEFAULT,
+                 legacy_steps: int = C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT,
+                 legacy_dir: str = C.TELEMETRY_PROFILE_DIR_DEFAULT):
+        d = d or {}
+        get = config_utils.get_scalar_param
+        self.start_step = get(d, C.TELEMETRY_PROFILE_BLOCK_START,
+                              legacy_start)
+        legacy_armed = isinstance(legacy_start, int) and \
+            not isinstance(legacy_start, bool) and legacy_start >= 0
+        self.window_steps = get(
+            d, C.TELEMETRY_PROFILE_BLOCK_STEPS,
+            legacy_steps if legacy_armed
+            else C.TELEMETRY_PROFILE_BLOCK_STEPS_DEFAULT)
+        self.out_dir = get(d, C.TELEMETRY_PROFILE_BLOCK_DIR, legacy_dir)
+        self.divergence_threshold = get(
+            d, C.TELEMETRY_PROFILE_THRESHOLD,
+            C.TELEMETRY_PROFILE_THRESHOLD_DEFAULT)
+        self.host_frac = get(d, C.TELEMETRY_PROFILE_HOST_FRAC,
+                             C.TELEMETRY_PROFILE_HOST_FRAC_DEFAULT)
+        self._validate()
+
+    def _validate(self) -> None:
+        blk = f"{C.TELEMETRY}.{C.TELEMETRY_PROFILE}"
+        if not isinstance(self.start_step, int) or \
+                isinstance(self.start_step, bool):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_PROFILE_BLOCK_START} must be an int "
+                f"(-1 = off), got {self.start_step!r}")
+        if not isinstance(self.window_steps, int) or \
+                isinstance(self.window_steps, bool) or \
+                self.window_steps <= 0:
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_PROFILE_BLOCK_STEPS} must be a "
+                f"positive int, got {self.window_steps!r}")
+        for name, v in ((C.TELEMETRY_PROFILE_THRESHOLD,
+                         self.divergence_threshold),
+                        (C.TELEMETRY_PROFILE_HOST_FRAC, self.host_frac)):
+            if not isinstance(v, (int, float)) or isinstance(v, bool) or \
+                    v <= 0:
+                raise DeepSpeedConfigError(
+                    f"{blk}.{name} must be a positive number, got {v!r}")
+        if not isinstance(self.out_dir, str):
+            raise DeepSpeedConfigError(
+                f"{blk}.{C.TELEMETRY_PROFILE_BLOCK_DIR} must be a string, "
+                f"got {self.out_dir!r}")
+
+
 class TelemetryConfig:
     """The ``telemetry`` block (monitor/ subsystem).
 
@@ -255,12 +310,20 @@ class TelemetryConfig:
         self.watermark_slack_bytes = get(
             d, C.TELEMETRY_WATERMARK_SLACK_BYTES,
             C.TELEMETRY_WATERMARK_SLACK_BYTES_DEFAULT)
-        self.profile_start_step = get(d, C.TELEMETRY_PROFILE_START_STEP,
-                                      C.TELEMETRY_PROFILE_START_STEP_DEFAULT)
-        self.profile_num_steps = get(d, C.TELEMETRY_PROFILE_NUM_STEPS,
-                                     C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT)
-        self.profile_dir = get(d, C.TELEMETRY_PROFILE_DIR,
-                               C.TELEMETRY_PROFILE_DIR_DEFAULT)
+        legacy_start = get(d, C.TELEMETRY_PROFILE_START_STEP,
+                           C.TELEMETRY_PROFILE_START_STEP_DEFAULT)
+        legacy_steps = get(d, C.TELEMETRY_PROFILE_NUM_STEPS,
+                           C.TELEMETRY_PROFILE_NUM_STEPS_DEFAULT)
+        legacy_dir = get(d, C.TELEMETRY_PROFILE_DIR,
+                         C.TELEMETRY_PROFILE_DIR_DEFAULT)
+        self.profile = TelemetryProfileConfig(
+            d.get(C.TELEMETRY_PROFILE), legacy_start=legacy_start,
+            legacy_steps=legacy_steps, legacy_dir=legacy_dir)
+        # Flat aliases kept in sync with the resolved block (telemetry.py
+        # and older callers read these).
+        self.profile_start_step = self.profile.start_step
+        self.profile_num_steps = self.profile.window_steps
+        self.profile_dir = self.profile.out_dir
         self.cost_model = get(d, C.TELEMETRY_COST_MODEL,
                               C.TELEMETRY_COST_MODEL_DEFAULT)
         self.per_host_shards = get(d, C.TELEMETRY_PER_HOST,

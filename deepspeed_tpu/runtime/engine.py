@@ -3120,6 +3120,20 @@ class DeepSpeedEngine:
             extra["skipped_steps"] = self.skipped_steps
         return extra
 
+    def profile_window(self, steps: int,
+                       start_step: Optional[int] = None) -> Optional[str]:
+        """Arm a ``jax.profiler`` capture over ``steps`` hot training
+        steps (default: starting at the next ``train_batch``). The
+        trace is ingested into the per-step wall decomposition and
+        reconciled against the roofline cost model at the next telemetry
+        drain (``telemetry.profile`` block); with telemetry off this is
+        a no-op returning None. Returns the capture dir. Zero device
+        syncs are added when no window is armed — the PR-4 fence
+        contract."""
+        return self.telemetry.arm_profile_window(
+            int(steps), start_step=self.global_steps + 1
+            if start_step is None else int(start_step))
+
     # ------------------------------------------------------------------ #
     # Roofline cost model (monitor/cost_model.py)
     # ------------------------------------------------------------------ #

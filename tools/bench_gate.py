@@ -290,6 +290,74 @@ def extract_metrics(doc: Dict[str, Any]) -> Dict[str, Optional[float]]:
             "ckpt_share": ckpt_share, "ckpt_every": ckpt_every}
 
 
+# Measurement-label ranks for the trace-truth ratchet (tools/
+# tpu_truth.py): "projected" = analytic model only; "cpu-structural" =
+# the identical pipeline ran end-to-end on a CPU mesh (structure
+# verified, magnitudes not TPU); "measured" = a real TPU trace backs the
+# number. Moving DOWN from "measured" is a regression.
+LABEL_RANK = {"projected": 0, "cpu-structural": 1, "measured": 2}
+
+
+def extract_labels(doc: Dict[str, Any]
+                   ) -> Optional[Dict[str, Dict[str, Any]]]:
+    """{artifact_name: {"label", "reconciled"}} from a TRUTH.json-style
+    doc (``artifacts`` map), a bench doc carrying a ``labels`` map, or a
+    single-artifact doc with a top-level ``label``. None = the doc
+    predates the truth campaign (ratchet skips, never fails)."""
+    arts = doc.get("artifacts")
+    if not isinstance(arts, dict):
+        d = doc.get("parsed") if isinstance(doc.get("parsed"), dict) \
+            else doc
+        arts = d.get("labels")
+        if not isinstance(arts, dict):
+            if isinstance(d.get("label"), str):
+                arts = {str(d.get("artifact", "bench")): d}
+            else:
+                return None
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, rec in arts.items():
+        if not isinstance(rec, dict) or not isinstance(rec.get("label"),
+                                                       str):
+            continue
+        out[name] = {
+            "label": rec["label"],
+            "reconciled": isinstance(rec.get("reconciliation"), dict),
+        }
+    return out or None
+
+
+def label_ratchet(old_doc: Dict[str, Any], new_doc: Dict[str, Any]
+                  ) -> Optional[List[str]]:
+    """The measured-stays-measured ratchet. Returns None when either
+    side predates the truth campaign (skip); otherwise the list of
+    ratchet violations (empty = OK): an artifact labeled ``measured``
+    in the old round that is missing, downgraded, or stripped of its
+    reconciliation section in the new round."""
+    old_labels = extract_labels(old_doc)
+    new_labels = extract_labels(new_doc)
+    if old_labels is None or new_labels is None:
+        return None
+    failures: List[str] = []
+    for name, o in sorted(old_labels.items()):
+        o_rank = LABEL_RANK.get(o["label"], 0)
+        n = new_labels.get(name)
+        if o_rank >= LABEL_RANK["measured"]:
+            if n is None:
+                failures.append(
+                    f"{name}: measured artifact dropped from the round")
+                continue
+            n_rank = LABEL_RANK.get(n["label"], 0)
+            if n_rank < o_rank:
+                failures.append(
+                    f"{name}: label regressed measured -> "
+                    f"{n['label']!r}")
+        if o["reconciled"] and n is not None and not n["reconciled"]:
+            failures.append(
+                f"{name}: reconciliation section present in the old "
+                f"round, dropped in the new")
+    return failures
+
+
 def _round_key(path: str) -> Tuple[int, str]:
     m = re.search(r"_r(\d+)\.json$", os.path.basename(path))
     return (int(m.group(1)) if m else -1, path)
@@ -648,6 +716,26 @@ def gate(old_path: str, new_path: str, mfu_drop: float,
     else:
         print(f"health: skipped (no health section in {name_new} — "
               "pre-health round)")
+
+    # Trace-truth label ratchet: an artifact that earned its "measured"
+    # label (a real TPU trace backs the number) must keep it — a round
+    # regressing it to "projected"/"cpu-structural", dropping it, or
+    # stripping its reconciliation section FAILS. Pre-truth rounds
+    # (no labels either side) skip, never fail.
+    ratchet = label_ratchet(_load(old_path), _load(new_path))
+    if ratchet is None:
+        print("label ratchet: skipped (no measurement labels in "
+              f"{name_old} and/or {name_new} — pre-truth rounds)")
+    else:
+        compared += 1
+        verdict = "OK" if not ratchet else "REGRESSION"
+        print(f"label ratchet: {name_old} -> {name_new}: "
+              + ("; ".join(ratchet) if ratchet
+                 else "measured labels and reconciliation sections "
+                      "preserved")
+              + f": {verdict}")
+        if ratchet:
+            rc = 1
 
     if compared == 0:
         print("bench_gate: nothing comparable between the two files "

@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Trace-truth profiling self-check on the dp=8 CPU mesh (CI entry
+point: ``tools/run_tier1.sh --profile`` / ``PROFILE_GATE=1``).
+
+One short telemetry-enabled train run with a 2-step armed
+``jax.profiler`` window proves, end to end and with zero hardware:
+
+1. the capture is located, ingested, bucketed, and reconciled FROM THE
+   TELEMETRY JSONL ALONE (``profile_window`` event -> trace dir ->
+   ``profile`` report section) — no side channel;
+2. the per-step wall decomposition is exact: buckets + idle +
+   unattributed residual sum to the measured window wall within 5%;
+3. reconciliation emits a boundedness verdict for every registered
+   cost-model path;
+4. profiling adds ZERO host<->device sync fences when configured off
+   AND when armed but outside the window (the instrumented
+   ``device_sync_count`` counter vs a telemetry-disabled twin).
+
+Exit 0 = pass, 1 = any claim fails.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tests"))
+
+import tempfile      # noqa: E402
+
+import jax           # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+STEPS = 12
+WINDOW = (4, 2)      # start_step, window_steps
+SUM_TOLERANCE = 0.05
+
+
+def run_once(out_dir, telemetry: bool, profile=None, steps: int = STEPS):
+    """One dp=8 train run; returns hot-path device syncs (compiles
+    excluded). ``profile``: None = no profile block; (start, n) = armed
+    window."""
+    import deepspeed_tpu.utils.timer as timer_mod
+    from deepspeed_tpu.runtime.engine import DeepSpeedEngine
+    from simple_model import (base_config, random_batch, simple_loss_fn,
+                              simple_model_params)
+    cfg = base_config()
+    if telemetry:
+        tcfg = {"enabled": True, "output_path": out_dir,
+                "job_name": "profile_check", "report_steps": 5}
+        if profile is not None:
+            tcfg["profile"] = {"start_step": profile[0],
+                               "window_steps": profile[1]}
+        cfg["telemetry"] = tcfg
+    eng = DeepSpeedEngine(model=simple_loss_fn,
+                          model_params=simple_model_params(
+                              jax.random.PRNGKey(0)),
+                          config=cfg)
+    batch = random_batch(n=16)
+    # Warm up compiles before fencing: compile-time device traffic is
+    # not hot-path traffic.
+    eng.train_batch(batch=batch)
+    eng.train_batch(batch=batch)
+    before = timer_mod.device_sync_count()
+    for _ in range(steps - 2):
+        eng.train_batch(batch=batch)
+    synced = timer_mod.device_sync_count() - before
+    eng.telemetry.close()
+    return synced
+
+
+def main() -> int:
+    failures = []
+    with tempfile.TemporaryDirectory() as t_off, \
+            tempfile.TemporaryDirectory() as t_noprof, \
+            tempfile.TemporaryDirectory() as t_armed_out, \
+            tempfile.TemporaryDirectory() as t_prof:
+        # Fence twins: telemetry off / profile off / armed-but-outside.
+        syncs_off = run_once(t_off, telemetry=False)
+        syncs_noprof = run_once(t_noprof, telemetry=True, profile=None)
+        syncs_armed_out = run_once(t_armed_out, telemetry=True,
+                                   profile=(10 ** 6, 2))
+        if syncs_noprof != syncs_off:
+            failures.append(
+                f"fence: profiling-off telemetry run issued "
+                f"{syncs_noprof} device syncs vs {syncs_off} disabled")
+        if syncs_armed_out != syncs_off:
+            failures.append(
+                f"fence: armed-outside-window run issued "
+                f"{syncs_armed_out} device syncs vs {syncs_off} disabled")
+
+        # The profiled run: window over 2 post-warmup hot steps.
+        run_once(t_prof, telemetry=True, profile=WINDOW)
+
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "telemetry_report",
+            os.path.join(REPO, "tools", "telemetry_report.py"))
+        rep = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(rep)
+        jsonl = os.path.join(t_prof, "profile_check.jsonl")
+        summary = rep.summarize(jsonl)
+
+        if summary["truncated"] is not False:
+            failures.append(
+                f"truncated verdict {summary['truncated']!r} on a "
+                f"cleanly closed run")
+        prof = summary["profile"]
+        if not prof.get("available"):
+            failures.append("profile section unavailable — capture not "
+                            "ingested from the JSONL")
+        else:
+            wins = prof.get("windows") or []
+            ok_stop = [w for w in wins
+                       if w.get("phase") == "stop" and w.get("ok")]
+            if not ok_stop:
+                failures.append(f"no successful profile_window stop "
+                                f"event (windows: {wins})")
+            sc = prof.get("sum_check") or {}
+            frac = sc.get("explained_frac")
+            if frac is None or abs(frac - 1.0) > SUM_TOLERANCE:
+                failures.append(
+                    f"decomposition does not sum to the step wall "
+                    f"within {SUM_TOLERANCE:.0%}: explained_frac={frac} "
+                    f"(sum_check={sc})")
+            if not prof.get("n_device_ops"):
+                failures.append("ingest found zero device ops")
+            recon = prof.get("reconciliation")
+            if not recon:
+                failures.append("no reconciliation section (cost model "
+                                "missing at ingest time?)")
+            else:
+                if recon.get("verdict") not in ("match", "mismatch"):
+                    failures.append(
+                        f"boundedness verdict {recon.get('verdict')!r} "
+                        f"is not decisive")
+                registered = set(summary["roofline"].get("paths") or {})
+                verdicts = recon.get("paths") or {}
+                missing = registered - set(verdicts)
+                if missing:
+                    failures.append(
+                        f"registered paths without a boundedness "
+                        f"verdict: {sorted(missing)}")
+                bad = [k for k, v in verdicts.items()
+                       if v.get("verdict") not in
+                       ("match", "mismatch", "indeterminate",
+                        "unavailable")]
+                if bad:
+                    failures.append(f"malformed path verdicts: {bad}")
+            if not failures:
+                print(f"profile_check: per-step "
+                      f"wall={prof['per_step_wall_ms']}ms, buckets="
+                      f"{prof['per_step_ms']}, explained="
+                      f"{sc.get('explained_frac'):.1%}, verdict="
+                      f"{recon['verdict']} (dominant="
+                      f"{recon['dominant_bucket']}, predicted="
+                      f"{recon['predicted_bound']}), "
+                      f"paths={list((recon.get('paths') or {}))}, "
+                      f"added_syncs off/outside="
+                      f"{syncs_noprof - syncs_off}/"
+                      f"{syncs_armed_out - syncs_off}")
+    if failures:
+        for f in failures:
+            print(f"profile_check FAIL: {f}")
+        return 1
+    print("profile_check: PASS")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

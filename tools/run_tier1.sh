@@ -16,6 +16,13 @@
 #       per-replica goodput ledgers, a parseable serving_slo report
 #       section, and zero added hot-path device syncs vs a
 #       telemetry-disabled twin.
+#   --profile / PROFILE_GATE=1 : run the dp=8 trace-truth self-check
+#       (tools/profile_check.py): a 2-step armed jax.profiler window
+#       whose trace ingests, buckets, and reconciles from the telemetry
+#       JSONL alone (decomposition sums to the step wall within 5%, a
+#       boundedness verdict per registered path), plus twin-run fences
+#       proving zero added device syncs with profiling off AND armed
+#       outside the window.
 #   --resilience / RESILIENCE_GATE=1 : run the crash/kill/resume
 #       harness (tools/crashkill.py run --quick: real SIGTERM/SIGKILL
 #       at random steps incl. mid-write, loadable-latest probe after
@@ -31,6 +38,7 @@ for arg in "$@"; do
     --lint) LINT_GATE=1 ;;
     --health) HEALTH_GATE=1 ;;
     --serve-slo) SERVE_SLO_GATE=1 ;;
+    --profile) PROFILE_GATE=1 ;;
     --resilience) RESILIENCE_GATE=1 ;;
   esac
 done
@@ -45,6 +53,9 @@ if [ "${HEALTH_GATE:-0}" = "1" ]; then
 fi
 if [ "${SERVE_SLO_GATE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python tools/serve_slo_check.py || rc=1
+fi
+if [ "${PROFILE_GATE:-0}" = "1" ]; then
+  env JAX_PLATFORMS=cpu python tools/profile_check.py || rc=1
 fi
 if [ "${RESILIENCE_GATE:-0}" = "1" ]; then
   env JAX_PLATFORMS=cpu python tools/crashkill.py run --quick || rc=1

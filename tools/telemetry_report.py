@@ -710,6 +710,49 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
             "overlapped": offload_steps[-1].get("overlapped"),
         }
 
+    # Profile: the measured half of the roofline story — capture-window
+    # outcomes (structured profile_window events), the bucketed per-step
+    # wall decomposition from the ingested jax.profiler trace, and the
+    # reconciliation verdict + divergences against the analytic floors.
+    windows = [e for e in events if e.get("event") == "profile_window"]
+    prof_events = [e for e in events if e.get("event") == "profile"]
+    div_events = [e for e in events
+                  if e.get("event") == "reconcile_divergence"]
+    profile: Dict[str, Any] = {"available": bool(prof_events)}
+    if windows:
+        profile["windows"] = [
+            {k: w.get(k) for k in ("phase", "path", "start_step",
+                                   "stop_step", "ok", "reason") if k in w}
+            for w in windows]
+    if prof_events:
+        last = prof_events[-1]
+        d = last.get("decomposition") or {}
+        r = last.get("reconciliation") or {}
+        profile.update({
+            "steps": d.get("steps"),
+            "per_step_wall_ms": d.get("per_step_wall_ms"),
+            "per_step_ms": d.get("per_step_ms"),
+            "sum_check": d.get("sum_check"),
+            "pallas_families_ms": d.get("pallas_families_ms"),
+            "n_device_ops": d.get("n_device_ops"),
+        })
+        if last.get("error"):
+            profile["error"] = last["error"]
+        if r:
+            profile["reconciliation"] = {
+                "verdict": r.get("verdict"),
+                "dominant_bucket": r.get("dominant_bucket"),
+                "predicted_bound": r.get("predicted_bound"),
+                "components": r.get("components"),
+                "paths": r.get("paths"),
+            }
+    if div_events:
+        profile["divergences"] = [
+            {k: e.get(k) for k in ("component", "measured_ms", "floor_ms",
+                                   "measured_over_floor", "wall_frac",
+                                   "threshold", "step") if k in e}
+            for e in div_events]
+
     return {
         "source": os.path.basename(jsonl_path),
         "meta": {k: v for k, v in meta.items() if k not in ("kind", "ts")},
@@ -748,6 +791,7 @@ def summarize(jsonl_path: str) -> Dict[str, Any]:
         "serving_slo": serving_slo,
         "moe": moe,
         "health": health,
+        "profile": profile,
         "truncated": truncated,
     }
 
@@ -798,6 +842,12 @@ def main(argv=None) -> int:
               summary["serving_slo"]["slo"]["burn"].items())
              if summary["serving_slo"].get("slo") else "")
           + health_bits
+          + ((lambda p: f", profiled: {p['reconciliation']['verdict']} "
+              f"(dominant={p['reconciliation']['dominant_bucket']}, "
+              f"predicted={p['reconciliation']['predicted_bound']})"
+              if p.get("reconciliation") else ", profiled")(
+                  summary["profile"])
+             if summary["profile"].get("available") else "")
           + (" — TRUNCATED segment (no final drain marker): stats "
              "cover a partial run" if summary["truncated"] else ""))
     return 0
